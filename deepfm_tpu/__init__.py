@@ -1,0 +1,12 @@
+"""deepfm_tpu: a TPU-native distributed CTR-training framework.
+
+Brand-new JAX/XLA/pjit framework with the capabilities of the SageMaker
+DeepFM distributed-training reference (async parameter-server CPU recipe +
+Horovod/NCCL GPU recipe), re-designed TPU-first: synchronous data parallelism
+and embedding-table row-sharding over a `jax.sharding.Mesh`, with XLA
+collectives replacing both the gRPC parameter server and NCCL allreduce.
+"""
+
+__version__ = "0.1.0"
+
+from .config import Config, parse_args  # noqa: F401

@@ -1,0 +1,201 @@
+"""Configuration system for deepfm_tpu.
+
+Reproduces the reference's full flag surface (``tf.app.flags`` definitions at
+``1-ps-cpu/DeepFM-dist-ps-for-multipleCPU-multiInstance.py:35-71`` and
+``2-hvd-gpu/DeepFM-hvd-tfrecord-vectorized-map.py:40-68``) as a single typed
+dataclass with an argparse CLI front-end, plus environment-variable defaults
+mirroring the SageMaker container contract (``SM_HOSTS``, ``SM_CURRENT_HOST``,
+``SM_CHANNELS``, ``SM_NUM_CPUS`` — reference ``1-ps-cpu/...py:64-67,346``).
+
+TPU-first deltas from the reference:
+  * ``dist_mode`` selects the JAX process topology instead of TF_CONFIG roles.
+  * ``mesh_data`` / ``mesh_model`` describe the 2-D device mesh (data
+    parallelism x embedding row-sharding) instead of PS/Horovod knobs.
+  * the MKL/OMP thread flags are replaced by host-pipeline worker counts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+
+def _env_json(name: str, default: Any) -> Any:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return json.loads(raw)
+    except (json.JSONDecodeError, TypeError):
+        return default
+
+
+@dataclasses.dataclass
+class Config:
+    """Full training configuration.
+
+    Field-by-field parity with the reference flag tables; reference flag name
+    noted where it differs.
+    """
+
+    # ---- task & topology (reference: dist_mode, task_type) ----
+    task_type: str = "train"          # train | eval | infer | export
+    dist_mode: int = 0                # 0: single/auto, 1: local fake cluster, 2: multi-process
+    num_processes: int = 1            # world size for dist_mode>0 (SM_HOSTS analog)
+    process_id: int = 0               # this process's rank (SM_CURRENT_HOST analog)
+    coordinator_address: str = ""     # jax.distributed coordinator (host:port)
+
+    # ---- model hyperparameters (reference: model flags) ----
+    model: str = "deepfm"             # deepfm | widedeep | dcnv2
+    feature_size: int = 117581        # vocabulary size (reference ipynb:85)
+    field_size: int = 39              # number of fields (reference ipynb:90)
+    embedding_size: int = 32          # latent dim (reference flag default, ...py:44)
+    deep_layers: str = "128,64,32"    # DNN tower widths (reference ipynb:90)
+    dropout: str = "0.5,0.5,0.5"      # per-layer keep... reference semantics: dropout rates
+    batch_norm: bool = False
+    batch_norm_decay: float = 0.9
+    cross_layers: int = 3             # DCN-v2 only: number of cross layers
+    cross_rank: int = 0               # DCN-v2: low-rank dim for cross W (0 = full rank)
+    l2_reg: float = 1e-4
+    loss_type: str = "log_loss"       # log_loss | square_loss
+
+    # ---- optimization ----
+    optimizer: str = "Adam"           # Adam | Adagrad | Momentum | ftrl
+    learning_rate: float = 5e-4
+    scale_lr_by_world: bool = True    # reference hvd: lr * hvd.size() (2-hvd-gpu/...py:149)
+    num_epochs: int = 1
+    batch_size: int = 1024            # GLOBAL batch size (split over data axis)
+
+    # ---- input pipeline (reference: pipe_mode, shard flags) ----
+    data_dir: str = ""
+    val_data_dir: str = ""
+    pipe_mode: int = 0                # 0: file mode, 1: streaming mode (Pipe analog)
+    channels: str = ""                # JSON list of channel names (SM_CHANNELS analog)
+    enable_s3_shard: bool = False     # files pre-sharded per process (ShardedByS3Key analog)
+    enable_data_multi_path: bool = False  # one channel/dir per local worker (hvd flag ...py:68)
+    worker_per_host: int = 1          # reference 2-hvd-gpu/...py:64
+    shuffle_buffer: int = 10000
+    shuffle_files: bool = True
+    drop_remainder: bool = True
+    prefetch_batches: int = 4
+    reader_threads: int = 4           # host decode parallelism (MKL/OMP analog)
+    use_native_decoder: bool = True   # C++ TFRecord decode path
+
+    # ---- mesh / parallelism (replaces TF_CONFIG + horovod knobs) ----
+    mesh_data: int = 0                # data-parallel axis size (0 = all devices)
+    mesh_model: int = 1               # embedding row-shard axis size
+    param_dtype: str = "float32"
+    compute_dtype: str = "bfloat16"   # MXU-friendly activations/matmuls
+    remat: bool = False               # jax.checkpoint the DNN tower
+
+    # ---- checkpoint / export / logging ----
+    model_dir: str = ""               # checkpoint dir (shared storage; reference :434)
+    servable_model_dir: str = ""      # serving export dir (reference :52)
+    clear_existing_model: bool = False  # reference 2-hvd-gpu/...py:60
+    log_steps: int = 10               # reference flag :47 (value 10 in ipynb:90)
+    save_checkpoints_steps: int = 1000
+    keep_checkpoint_max: int = 3
+    eval_start_delay_secs: int = 0    # reference TrainSpec/EvalSpec (1-ps-cpu/...py:440-441)
+    eval_throttle_secs: int = 0
+    auc_num_thresholds: int = 200     # parity with tf.metrics.auc default
+    seed: int = 42
+    profile_dir: str = ""             # jax.profiler trace output ('' = disabled)
+
+    # ------------------------------------------------------------------
+    def __post_init__(self) -> None:
+        self.validate()
+
+    def validate(self) -> None:
+        if self.task_type not in ("train", "eval", "infer", "export"):
+            raise ValueError(f"unknown task_type: {self.task_type!r}")
+        if self.model not in ("deepfm", "widedeep", "dcnv2"):
+            raise ValueError(f"unknown model: {self.model!r}")
+        if self.optimizer.lower() not in ("adam", "adagrad", "momentum", "ftrl", "sgd"):
+            raise ValueError(f"unknown optimizer: {self.optimizer!r}")
+        if self.loss_type not in ("log_loss", "square_loss"):
+            raise ValueError(f"unknown loss_type: {self.loss_type!r}")
+        if self.feature_size <= 0 or self.field_size <= 0 or self.embedding_size <= 0:
+            raise ValueError("feature_size/field_size/embedding_size must be positive")
+        if self.batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        if self.mesh_model < 1:
+            raise ValueError("mesh_model must be >= 1")
+
+    # ---- derived views ------------------------------------------------
+    @property
+    def deep_layer_sizes(self) -> List[int]:
+        return [int(x) for x in self.deep_layers.split(",") if x.strip()]
+
+    @property
+    def dropout_rates(self) -> List[float]:
+        return [float(x) for x in self.dropout.split(",") if x.strip()]
+
+    @property
+    def channel_names(self) -> List[str]:
+        if not self.channels:
+            return []
+        val = self.channels
+        if isinstance(val, str):
+            try:
+                parsed = json.loads(val)
+            except json.JSONDecodeError:
+                parsed = [c for c in val.split(",") if c]
+            return list(parsed)
+        return list(val)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Config":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    def replace(self, **kw: Any) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+
+def _add_bool_arg(p: argparse.ArgumentParser, name: str, default: bool, help_: str) -> None:
+    p.add_argument(f"--{name}", type=lambda s: s.lower() in ("1", "true", "yes"),
+                   default=default, help=help_)
+
+
+def build_arg_parser(defaults: Optional[Config] = None) -> argparse.ArgumentParser:
+    """argparse mirror of the dataclass; hyperparameter-dict→argv compatible.
+
+    The SageMaker launcher passed hyperparameters as ``--key value`` argv
+    (reference ``deepfm-sagemaker-ps-cpu.ipynb:89-95``); this parser accepts
+    the same shape.
+    """
+    d = defaults or Config()
+    p = argparse.ArgumentParser("deepfm_tpu", description="TPU-native DeepFM trainer")
+    for f in dataclasses.fields(Config):
+        default = getattr(d, f.name)
+        if f.type == "bool" or isinstance(default, bool):
+            _add_bool_arg(p, f.name, default, f"(default: {default})")
+        elif isinstance(default, int):
+            p.add_argument(f"--{f.name}", type=int, default=default)
+        elif isinstance(default, float):
+            p.add_argument(f"--{f.name}", type=float, default=default)
+        else:
+            p.add_argument(f"--{f.name}", type=str, default=default)
+    return p
+
+
+def parse_args(argv: Optional[Sequence[str]] = None) -> Config:
+    # Environment defaults mirroring the SageMaker env contract.
+    env = Config(
+        channels=os.environ.get("SM_CHANNELS", ""),
+        data_dir=os.environ.get("SM_CHANNEL_TRAINING", ""),
+        val_data_dir=os.environ.get("SM_CHANNEL_EVAL", ""),
+        model_dir=os.environ.get("DEEPFM_MODEL_DIR", ""),
+        num_processes=len(_env_json("SM_HOSTS", [None])) or 1,
+    )
+    ns = build_arg_parser(env).parse_args(argv)
+    return Config.from_dict(vars(ns))

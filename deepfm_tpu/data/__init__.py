@@ -1,0 +1,3 @@
+from . import example_codec, libsvm, pipeline, sharding, tfrecord  # noqa: F401
+from .pipeline import Batch, CtrPipeline, StreamingCtrPipeline  # noqa: F401
+from .sharding import ShardSpec, shard_files  # noqa: F401

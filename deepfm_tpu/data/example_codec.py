@@ -1,0 +1,296 @@
+"""Minimal ``tf.train.Example`` wire-format codec (no TensorFlow dependency).
+
+The reference keeps all data as TFRecord files of ``tf.train.Example`` protos
+with schema ``{label: float, feat_ids: int64[F], feat_vals: float[F]}``
+(written by ``tools/libsvm_to_tfrecord.py:25-33``, decoded vectorized at
+``1-ps-cpu/DeepFM-dist-ps-for-multipleCPU-multiInstance.py:81-86``). We keep
+TFRecord as the on-disk format for drop-in compatibility, but implement the
+codec ourselves: this module is the pure-Python reference implementation; the
+C++ fast path lives in ``deepfm_tpu/native/``.
+
+Wire format facts used (protobuf encoding spec):
+  Example        { Features features = 1; }
+  Features       { map<string, Feature> feature = 1; }   // map entry: key=1, value=2
+  Feature        { oneof { BytesList bytes_list = 1; FloatList float_list = 2;
+                           Int64List int64_list = 3; } }
+  BytesList      { repeated bytes value = 1; }
+  FloatList      { repeated float value = 1 [packed]; }
+  Int64List      { repeated int64 value = 1 [packed]; }
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Tuple, Union
+
+import numpy as np
+
+FeatureValue = Union[np.ndarray, List[float], List[int], List[bytes]]
+
+# ---------------------------------------------------------------------------
+# varint / tag helpers
+# ---------------------------------------------------------------------------
+
+
+def write_varint(n: int, out: bytearray) -> None:
+    if n < 0:
+        n &= (1 << 64) - 1  # two's complement, 64-bit
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 70:
+            raise ValueError("malformed varint")
+
+
+def _tag(field_number: int, wire_type: int) -> int:
+    return (field_number << 3) | wire_type
+
+
+def _write_len_delimited(field_number: int, payload: bytes, out: bytearray) -> None:
+    write_varint(_tag(field_number, 2), out)
+    write_varint(len(payload), out)
+    out += payload
+
+
+# ---------------------------------------------------------------------------
+# Feature encode
+# ---------------------------------------------------------------------------
+
+
+def _encode_float_list(values: np.ndarray) -> bytes:
+    packed = np.asarray(values, dtype="<f4").tobytes()
+    inner = bytearray()
+    _write_len_delimited(1, packed, inner)  # FloatList.value packed
+    return bytes(inner)
+
+
+def _encode_int64_list(values: np.ndarray) -> bytes:
+    inner = bytearray()
+    payload = bytearray()
+    for v in np.asarray(values, dtype=np.int64).tolist():
+        write_varint(v, payload)
+    _write_len_delimited(1, bytes(payload), inner)  # Int64List.value packed
+    return bytes(inner)
+
+
+def _encode_bytes_list(values: List[bytes]) -> bytes:
+    inner = bytearray()
+    for v in values:
+        _write_len_delimited(1, v, inner)
+    return bytes(inner)
+
+
+def encode_feature(value: FeatureValue, kind: str) -> bytes:
+    """Encode one Feature message. kind in {'float','int64','bytes'}."""
+    out = bytearray()
+    if kind == "float":
+        _write_len_delimited(2, _encode_float_list(np.asarray(value)), out)
+    elif kind == "int64":
+        _write_len_delimited(3, _encode_int64_list(np.asarray(value)), out)
+    elif kind == "bytes":
+        _write_len_delimited(1, _encode_bytes_list(list(value)), out)
+    else:
+        raise ValueError(f"unknown feature kind {kind!r}")
+    return bytes(out)
+
+
+def encode_example(features: Dict[str, Tuple[FeatureValue, str]]) -> bytes:
+    """Serialize an Example. ``features`` maps name -> (value, kind)."""
+    feat_map = bytearray()
+    for name, (value, kind) in features.items():
+        entry = bytearray()
+        _write_len_delimited(1, name.encode("utf-8"), entry)      # key
+        _write_len_delimited(2, encode_feature(value, kind), entry)  # value
+        _write_len_delimited(1, bytes(entry), feat_map)           # map entry
+    out = bytearray()
+    _write_len_delimited(1, bytes(feat_map), out)  # Example.features
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# Feature decode
+# ---------------------------------------------------------------------------
+
+
+def _skip_field(buf: bytes, pos: int, wire_type: int) -> int:
+    if wire_type == 0:
+        _, pos = read_varint(buf, pos)
+    elif wire_type == 1:
+        pos += 8
+    elif wire_type == 2:
+        n, pos = read_varint(buf, pos)
+        pos += n
+    elif wire_type == 5:
+        pos += 4
+    else:
+        raise ValueError(f"unsupported wire type {wire_type}")
+    return pos
+
+
+def _decode_float_list(buf: bytes) -> np.ndarray:
+    pos, end = 0, len(buf)
+    chunks: List[np.ndarray] = []
+    while pos < end:
+        tag, pos = read_varint(buf, pos)
+        field, wt = tag >> 3, tag & 7
+        if field == 1 and wt == 2:  # packed
+            n, pos = read_varint(buf, pos)
+            chunks.append(np.frombuffer(buf, dtype="<f4", count=n // 4, offset=pos))
+            pos += n
+        elif field == 1 and wt == 5:  # unpacked fixed32
+            chunks.append(np.frombuffer(buf, dtype="<f4", count=1, offset=pos))
+            pos += 4
+        else:
+            pos = _skip_field(buf, pos, wt)
+    if not chunks:
+        return np.zeros((0,), np.float32)
+    return np.concatenate(chunks) if len(chunks) > 1 else chunks[0].copy()
+
+
+def _decode_int64_list(buf: bytes) -> np.ndarray:
+    pos, end = 0, len(buf)
+    vals: List[int] = []
+    while pos < end:
+        tag, pos = read_varint(buf, pos)
+        field, wt = tag >> 3, tag & 7
+        if field == 1 and wt == 2:  # packed
+            n, pos = read_varint(buf, pos)
+            stop = pos + n
+            while pos < stop:
+                v, pos = read_varint(buf, pos)
+                if v >= 1 << 63:
+                    v -= 1 << 64
+                vals.append(v)
+        elif field == 1 and wt == 0:
+            v, pos = read_varint(buf, pos)
+            if v >= 1 << 63:
+                v -= 1 << 64
+            vals.append(v)
+        else:
+            pos = _skip_field(buf, pos, wt)
+    return np.asarray(vals, dtype=np.int64)
+
+
+def _decode_bytes_list(buf: bytes) -> List[bytes]:
+    pos, end = 0, len(buf)
+    vals: List[bytes] = []
+    while pos < end:
+        tag, pos = read_varint(buf, pos)
+        field, wt = tag >> 3, tag & 7
+        if field == 1 and wt == 2:
+            n, pos = read_varint(buf, pos)
+            vals.append(buf[pos:pos + n])
+            pos += n
+        else:
+            pos = _skip_field(buf, pos, wt)
+    return vals
+
+
+def decode_feature(buf: bytes) -> Tuple[str, FeatureValue]:
+    """Decode one Feature message -> (kind, value)."""
+    pos, end = 0, len(buf)
+    while pos < end:
+        tag, pos = read_varint(buf, pos)
+        field, wt = tag >> 3, tag & 7
+        if wt != 2:
+            pos = _skip_field(buf, pos, wt)
+            continue
+        n, pos = read_varint(buf, pos)
+        payload = buf[pos:pos + n]
+        pos += n
+        if field == 1:
+            return "bytes", _decode_bytes_list(payload)
+        if field == 2:
+            return "float", _decode_float_list(payload)
+        if field == 3:
+            return "int64", _decode_int64_list(payload)
+    return "bytes", []
+
+
+def decode_example(buf: bytes) -> Dict[str, Tuple[str, FeatureValue]]:
+    """Parse a serialized Example -> {name: (kind, value)}."""
+    out: Dict[str, Tuple[str, FeatureValue]] = {}
+    pos, end = 0, len(buf)
+    while pos < end:
+        tag, pos = read_varint(buf, pos)
+        field, wt = tag >> 3, tag & 7
+        if field == 1 and wt == 2:  # Example.features
+            n, pos = read_varint(buf, pos)
+            fpos, fend = pos, pos + n
+            pos = fend
+            while fpos < fend:
+                ftag, fpos = read_varint(buf, fpos)
+                ffield, fwt = ftag >> 3, ftag & 7
+                if ffield == 1 and fwt == 2:  # map entry
+                    en, fpos = read_varint(buf, fpos)
+                    epos, eend = fpos, fpos + en
+                    fpos = eend
+                    key = b""
+                    feat = b""
+                    while epos < eend:
+                        etag, epos = read_varint(buf, epos)
+                        efield, ewt = etag >> 3, etag & 7
+                        if ewt != 2:
+                            epos = _skip_field(buf, epos, ewt)
+                            continue
+                        vn, epos = read_varint(buf, epos)
+                        if efield == 1:
+                            key = buf[epos:epos + vn]
+                        elif efield == 2:
+                            feat = buf[epos:epos + vn]
+                        epos += vn
+                    out[key.decode("utf-8")] = decode_feature(feat)
+                else:
+                    fpos = _skip_field(buf, fpos, fwt)
+        else:
+            pos = _skip_field(buf, pos, wt)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Fixed-schema fast path used by the input pipeline
+# ---------------------------------------------------------------------------
+
+LABEL_KEY = "label"
+IDS_KEY = "feat_ids"
+VALS_KEY = "feat_vals"
+
+
+def encode_ctr_example(label: float, ids: np.ndarray, vals: np.ndarray) -> bytes:
+    """Encode the reference CTR schema (tools/libsvm_to_tfrecord.py:25-33)."""
+    return encode_example({
+        LABEL_KEY: (np.asarray([label], np.float32), "float"),
+        IDS_KEY: (np.asarray(ids, np.int64), "int64"),
+        VALS_KEY: (np.asarray(vals, np.float32), "float"),
+    })
+
+
+def decode_ctr_example(buf: bytes, field_size: int) -> Tuple[float, np.ndarray, np.ndarray]:
+    """Decode one CTR Example; validates fixed field_size (parse_example analog)."""
+    feats = decode_example(buf)
+    _, label = feats[LABEL_KEY]
+    _, ids = feats[IDS_KEY]
+    _, vals = feats[VALS_KEY]
+    ids = np.asarray(ids, np.int64)
+    vals = np.asarray(vals, np.float32)
+    if ids.shape[0] != field_size or vals.shape[0] != field_size:
+        raise ValueError(
+            f"expected field_size={field_size}, got ids={ids.shape[0]} vals={vals.shape[0]}")
+    return float(np.asarray(label, np.float32)[0]), ids, vals

@@ -1,0 +1,241 @@
+"""Host-side input pipeline: TFRecord -> fixed-shape numpy batches for TPU.
+
+TPU-native re-design of the reference's two ``input_fn`` flavors
+(``1-ps-cpu/...py:76-133`` file/pipe, ``2-hvd-gpu/...py:74-133`` horovod):
+
+  * File mode: per-epoch file-list shuffle, shard policy (``sharding.py``),
+    record shuffle buffer, batch -> *vectorized* decode (the reference decodes
+    with ``tf.parse_example`` after ``.batch()`` — here the batched decode is
+    the native C++ decoder or the pure-Python codec), drop_remainder, repeat.
+  * Streaming mode (Pipe analog): sequential non-seekable stream, one pass,
+    no re-open per epoch (the FIFO pitfall at ``2-hvd-gpu/...py:396``).
+  * Prefetch: a background thread keeps ``prefetch_batches`` ready, the host
+    analog of ``dataset.prefetch`` — with TPU async dispatch this overlaps
+    host decode with device step time.
+
+Outputs fixed-shape batches ``{"feat_ids": int32[B,F], "feat_vals": f32[B,F],
+"label": f32[B,1]}`` — static shapes so every step hits the same XLA program.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import BinaryIO, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import example_codec, sharding, tfrecord
+
+Batch = Dict[str, np.ndarray]
+
+
+def decode_batch_python(records: Sequence[bytes], field_size: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Vectorized-decode fallback: parse each Example with the Python codec."""
+    n = len(records)
+    labels = np.empty((n,), np.float32)
+    ids = np.empty((n, field_size), np.int32)
+    vals = np.empty((n, field_size), np.float32)
+    for i, rec in enumerate(records):
+        lab, rid, rval = example_codec.decode_ctr_example(rec, field_size)
+        labels[i] = lab
+        ids[i] = rid.astype(np.int32)
+        vals[i] = rval
+    return labels, ids, vals
+
+
+def _get_decoder(use_native: bool):
+    if use_native:
+        try:
+            from ..native import loader  # noqa: PLC0415 (lazy: builds .so on first use)
+            if loader.available():
+                return loader.decode_batch
+        except Exception:
+            pass
+    return decode_batch_python
+
+
+class CtrPipeline:
+    """TFRecord CTR input pipeline producing fixed-shape numpy batches."""
+
+    def __init__(
+        self,
+        files: Sequence[str],
+        *,
+        field_size: int,
+        batch_size: int,
+        num_epochs: int = 1,
+        shuffle: bool = True,
+        shuffle_files: bool = True,
+        shuffle_buffer: int = 10000,
+        drop_remainder: bool = True,
+        seed: int = 42,
+        shard: Optional[sharding.ShardSpec] = None,
+        prefetch_batches: int = 4,
+        use_native_decoder: bool = True,
+    ):
+        if shard is not None:
+            self._files: Tuple[str, ...] = shard.files
+            self._record_shard = shard.record_shard
+        else:
+            self._files = tuple(files)
+            self._record_shard = None
+        self.field_size = field_size
+        self.batch_size = batch_size
+        self.num_epochs = num_epochs
+        self.shuffle = shuffle
+        self.shuffle_files = shuffle_files
+        self.shuffle_buffer = shuffle_buffer
+        self.drop_remainder = drop_remainder
+        self.seed = seed
+        self.prefetch_batches = prefetch_batches
+        self._decode = _get_decoder(use_native_decoder)
+
+    # ------------------------------------------------------------------
+    def _iter_raw_records(self, epoch: int) -> Iterator[bytes]:
+        files = list(self._files)
+        if self.shuffle_files:
+            # Per-epoch reshuffle, seeded: deterministic but epoch-varying
+            # (reference shuffles the file list once at :373-377).
+            np.random.default_rng(self.seed + epoch).shuffle(files)
+        n_seen = 0
+        for path in files:
+            for rec in tfrecord.iter_records(path):
+                keep = (
+                    self._record_shard is None
+                    or n_seen % self._record_shard[0] == self._record_shard[1]
+                )
+                n_seen += 1
+                if keep:
+                    yield rec
+        if n_seen == 0 and files:
+            raise IOError(f"no records found in {len(files)} files")
+
+    def _iter_shuffled(self, epoch: int) -> Iterator[bytes]:
+        """Buffered uniform shuffle (tf.data.Dataset.shuffle semantics)."""
+        if not self.shuffle or self.shuffle_buffer <= 1:
+            yield from self._iter_raw_records(epoch)
+            return
+        rng = np.random.default_rng(self.seed * 1_000_003 + epoch)
+        buf: List[bytes] = []
+        for rec in self._iter_raw_records(epoch):
+            if len(buf) < self.shuffle_buffer:
+                buf.append(rec)
+                continue
+            j = int(rng.integers(0, len(buf)))
+            yield buf[j]
+            buf[j] = rec
+        rng.shuffle(buf)
+        yield from buf
+
+    def _iter_batches_sync(self) -> Iterator[Batch]:
+        for epoch in range(self.num_epochs):
+            pending: List[bytes] = []
+            for rec in self._iter_shuffled(epoch):
+                pending.append(rec)
+                if len(pending) == self.batch_size:
+                    yield self._make_batch(pending)
+                    pending = []
+            if pending and not self.drop_remainder:
+                yield self._make_batch(pending)
+
+    def _make_batch(self, records: List[bytes]) -> Batch:
+        labels, ids, vals = self._decode(records, self.field_size)
+        return {
+            "feat_ids": np.ascontiguousarray(ids, np.int32),
+            "feat_vals": np.ascontiguousarray(vals, np.float32),
+            "label": labels.reshape(-1, 1).astype(np.float32),
+        }
+
+    # ------------------------------------------------------------------
+    def __iter__(self) -> Iterator[Batch]:
+        if self.prefetch_batches <= 0:
+            yield from self._iter_batches_sync()
+            return
+        yield from _prefetch(self._iter_batches_sync(), self.prefetch_batches)
+
+    def count_examples(self) -> int:
+        """One full pass counting records (respecting the shard)."""
+        return sum(1 for _ in self._iter_raw_records(epoch=0))
+
+
+class StreamingCtrPipeline:
+    """Pipe-mode analog: decode batches from a sequential byte stream.
+
+    Single pass only — the reference's FIFO cannot be re-opened per epoch
+    (``2-hvd-gpu/...py:396`` comment); callers wanting multiple epochs pass
+    ``num_epochs`` to the *producer* side, exactly like SageMaker Pipe mode
+    replays the channel.
+    """
+
+    def __init__(
+        self,
+        stream: BinaryIO,
+        *,
+        field_size: int,
+        batch_size: int,
+        drop_remainder: bool = True,
+        prefetch_batches: int = 4,
+        use_native_decoder: bool = True,
+    ):
+        self.stream = stream
+        self.field_size = field_size
+        self.batch_size = batch_size
+        self.drop_remainder = drop_remainder
+        self.prefetch_batches = prefetch_batches
+        self._decode = _get_decoder(use_native_decoder)
+        self._consumed = False
+
+    def _iter_sync(self) -> Iterator[Batch]:
+        if self._consumed:
+            raise RuntimeError(
+                "StreamingCtrPipeline is single-pass (Pipe-mode FIFO semantics); "
+                "create a new stream for another epoch")
+        self._consumed = True
+        pending: List[bytes] = []
+        for rec in tfrecord.iter_records_from_stream(self.stream):
+            pending.append(rec)
+            if len(pending) == self.batch_size:
+                labels, ids, vals = self._decode(pending, self.field_size)
+                yield {
+                    "feat_ids": np.ascontiguousarray(ids, np.int32),
+                    "feat_vals": np.ascontiguousarray(vals, np.float32),
+                    "label": labels.reshape(-1, 1).astype(np.float32),
+                }
+                pending = []
+        if pending and not self.drop_remainder:
+            labels, ids, vals = self._decode(pending, self.field_size)
+            yield {
+                "feat_ids": np.ascontiguousarray(ids, np.int32),
+                "feat_vals": np.ascontiguousarray(vals, np.float32),
+                "label": labels.reshape(-1, 1).astype(np.float32),
+            }
+
+    def __iter__(self) -> Iterator[Batch]:
+        if self.prefetch_batches <= 0:
+            return self._iter_sync()
+        return _prefetch(self._iter_sync(), self.prefetch_batches)
+
+
+def _prefetch(it: Iterator[Batch], depth: int) -> Iterator[Batch]:
+    """Run ``it`` in a daemon thread, keeping up to ``depth`` items ready."""
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    _END = object()
+
+    def worker() -> None:
+        try:
+            for item in it:
+                q.put(item)
+            q.put(_END)
+        except BaseException as e:  # propagate into consumer
+            q.put(e)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is _END:
+            return
+        if isinstance(item, BaseException):
+            raise item
+        yield item

@@ -1,0 +1,17 @@
+"""Test configuration: force an 8-device virtual CPU platform BEFORE jax init.
+
+This is the TPU-native analog of the reference's local-cluster escape hatch
+(`set_dist_env()`, 1-ps-cpu/...py:294-339): distributed semantics are tested
+on one machine by splitting the host CPU into 8 XLA devices.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

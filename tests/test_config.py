@@ -1,0 +1,55 @@
+"""Config-system tests: flag surface, CLI parity, validation."""
+
+import json
+
+import pytest
+
+from deepfm_tpu.config import Config, parse_args
+
+
+def test_defaults_match_reference_hparams():
+    c = Config()
+    # reference ipynb:82-90 / flag defaults
+    assert c.feature_size == 117581
+    assert c.field_size == 39
+    assert c.embedding_size == 32
+    assert c.batch_size == 1024
+    assert c.learning_rate == 5e-4
+    assert c.optimizer == "Adam"
+    assert c.deep_layer_sizes == [128, 64, 32]
+
+
+def test_cli_roundtrip():
+    c = parse_args([
+        "--task_type", "eval", "--batch_size", "64", "--batch_norm", "true",
+        "--deep_layers", "32,16", "--model", "dcnv2", "--mesh_model", "2",
+    ])
+    assert c.task_type == "eval"
+    assert c.batch_size == 64
+    assert c.batch_norm is True
+    assert c.deep_layer_sizes == [32, 16]
+    assert c.model == "dcnv2"
+    assert c.mesh_model == 2
+
+
+def test_validation_rejects_bad_values():
+    with pytest.raises(ValueError):
+        Config(task_type="bogus")
+    with pytest.raises(ValueError):
+        Config(model="mlp")
+    with pytest.raises(ValueError):
+        Config(optimizer="lbfgs")
+    with pytest.raises(ValueError):
+        Config(batch_size=0)
+
+
+def test_channels_json_and_csv():
+    assert Config(channels='["eval", "train_0"]').channel_names == ["eval", "train_0"]
+    assert Config(channels="eval,train_0").channel_names == ["eval", "train_0"]
+    assert Config().channel_names == []
+
+
+def test_serialization_roundtrip():
+    c = Config(batch_size=128, model="widedeep")
+    c2 = Config.from_dict(json.loads(c.to_json()))
+    assert c2 == c

@@ -1,0 +1,303 @@
+"""Data layer tests: codec, TFRecord framing, LibSVM conversion, shard policy,
+pipeline semantics. Includes cross-validation against TensorFlow's own
+TFRecord/Example implementation when TF is importable (format parity is a
+hard requirement: the reference's data files must be readable unmodified)."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from deepfm_tpu.data import example_codec, libsvm, pipeline, sharding, tfrecord
+
+
+def _mk_example(label=1.0, f=5, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, 1000, size=f).astype(np.int64)
+    vals = rng.normal(size=f).astype(np.float32)
+    return label, ids, vals
+
+
+class TestCodec:
+    def test_roundtrip(self):
+        label, ids, vals = _mk_example()
+        buf = example_codec.encode_ctr_example(label, ids, vals)
+        label2, ids2, vals2 = example_codec.decode_ctr_example(buf, 5)
+        assert label2 == label
+        np.testing.assert_array_equal(ids, ids2)
+        np.testing.assert_array_equal(vals, vals2)
+
+    def test_negative_int64(self):
+        buf = example_codec.encode_example(
+            {"x": (np.array([-1, -(2**62), 3], np.int64), "int64")})
+        out = example_codec.decode_example(buf)
+        kind, val = out["x"]
+        assert kind == "int64"
+        np.testing.assert_array_equal(val, [-1, -(2**62), 3])
+
+    def test_field_size_validation(self):
+        label, ids, vals = _mk_example(f=4)
+        buf = example_codec.encode_ctr_example(label, ids, vals)
+        with pytest.raises(ValueError):
+            example_codec.decode_ctr_example(buf, 5)
+
+    def test_tf_parity_decode_ours(self):
+        """TF must parse bytes we encode (writer-side format parity)."""
+        tf = pytest.importorskip("tensorflow")
+        label, ids, vals = _mk_example(f=7, seed=3)
+        buf = example_codec.encode_ctr_example(label, ids, vals)
+        ex = tf.train.Example.FromString(buf)
+        feat = ex.features.feature
+        assert list(feat["label"].float_list.value) == [label]
+        assert list(feat["feat_ids"].int64_list.value) == ids.tolist()
+        np.testing.assert_allclose(
+            np.array(feat["feat_vals"].float_list.value, np.float32), vals)
+
+    def test_tf_parity_decode_theirs(self):
+        """We must parse bytes TF encodes (reader-side format parity)."""
+        tf = pytest.importorskip("tensorflow")
+        label, ids, vals = _mk_example(f=6, seed=4)
+        ex = tf.train.Example(features=tf.train.Features(feature={
+            "label": tf.train.Feature(float_list=tf.train.FloatList(value=[label])),
+            "feat_ids": tf.train.Feature(int64_list=tf.train.Int64List(value=ids)),
+            "feat_vals": tf.train.Feature(float_list=tf.train.FloatList(value=vals)),
+        }))
+        l2, i2, v2 = example_codec.decode_ctr_example(ex.SerializeToString(), 6)
+        assert l2 == label
+        np.testing.assert_array_equal(i2, ids)
+        np.testing.assert_allclose(v2, vals, rtol=1e-6)
+
+
+class TestTFRecordIO:
+    def test_crc32c_known_vectors(self):
+        # RFC 3720 test vector: crc32c of 32 zero bytes.
+        assert tfrecord.crc32c(b"\x00" * 32) == 0x8A9136AA
+        assert tfrecord.crc32c(b"123456789") == 0xE3069283
+
+    def test_roundtrip(self, tmp_path):
+        path = str(tmp_path / "a.tfrecords")
+        recs = [os.urandom(n) for n in (1, 10, 1000)]
+        with tfrecord.TFRecordWriter(path) as w:
+            for r in recs:
+                w.write(r)
+        assert tfrecord.read_all_records(path) == recs
+
+    def test_corrupt_crc_detected(self, tmp_path):
+        path = str(tmp_path / "a.tfrecords")
+        with tfrecord.TFRecordWriter(path) as w:
+            w.write(b"hello world")
+        data = bytearray(open(path, "rb").read())
+        data[14] ^= 0xFF  # flip a payload byte
+        open(path, "wb").write(bytes(data))
+        with pytest.raises(IOError):
+            tfrecord.read_all_records(path)
+
+    def test_stream_iterator_no_seek(self, tmp_path):
+        recs = [b"a" * 5, b"b" * 17]
+        path = str(tmp_path / "s.tfrecords")
+        with tfrecord.TFRecordWriter(path) as w:
+            for r in recs:
+                w.write(r)
+
+        class NoSeek(io.RawIOBase):
+            def __init__(self, b):
+                self._b = io.BytesIO(b)
+            def read(self, n=-1):
+                return self._b.read(n)
+            def seekable(self):
+                return False
+
+        out = list(tfrecord.iter_records_from_stream(NoSeek(open(path, "rb").read())))
+        assert out == recs
+
+    def test_tf_reads_our_files(self, tmp_path):
+        tf = pytest.importorskip("tensorflow")
+        path = str(tmp_path / "ours.tfrecords")
+        label, ids, vals = _mk_example(f=5, seed=9)
+        with tfrecord.TFRecordWriter(path) as w:
+            w.write(example_codec.encode_ctr_example(label, ids, vals))
+        ds = tf.data.TFRecordDataset([path])
+        got = list(ds.as_numpy_iterator())
+        assert len(got) == 1
+        ex = tf.train.Example.FromString(got[0])
+        assert list(ex.features.feature["feat_ids"].int64_list.value) == ids.tolist()
+
+    def test_we_read_tf_files(self, tmp_path):
+        tf = pytest.importorskip("tensorflow")
+        path = str(tmp_path / "theirs.tfrecords")
+        with tf.io.TFRecordWriter(path) as w:
+            w.write(b"payload-1")
+            w.write(b"payload-22")
+        assert tfrecord.read_all_records(path) == [b"payload-1", b"payload-22"]
+
+
+class TestLibsvm:
+    def test_parse_format_roundtrip(self):
+        line = "1 3:0.5 17:1 999:-2.25"
+        label, ids, vals = libsvm.parse_libsvm_line(line)
+        assert label == 1.0
+        np.testing.assert_array_equal(ids, [3, 17, 999])
+        np.testing.assert_allclose(vals, [0.5, 1.0, -2.25])
+        assert libsvm.format_libsvm_line(label, ids, vals) == line
+
+    def test_convert_and_back(self, tmp_path):
+        src = tmp_path / "in.libsvm"
+        lines = ["1 0:0.1 1:0.2 2:0.3", "0 3:1 4:1 5:1"]
+        src.write_text("\n".join(lines) + "\n")
+        out = str(tmp_path / "out.tfrecords")
+        n = libsvm.convert_libsvm_file(str(src), out, field_size=3)
+        assert n == 2
+        back = str(tmp_path / "back.libsvm")
+        assert libsvm.tfrecord_to_libsvm(out, back, field_size=3) == 2
+        assert open(back).read().strip().split("\n") == lines
+
+    def test_sharded_output(self, tmp_path):
+        src = tmp_path / "in.libsvm"
+        src.write_text("\n".join(f"{i % 2} {i}:1.0" for i in range(10)) + "\n")
+        out = str(tmp_path / "out.tfrecords")
+        libsvm.convert_libsvm_file(str(src), out, num_shards=3)
+        counts = [
+            len(tfrecord.read_all_records(f"{out}-{s:05d}-of-00003"))
+            for s in range(3)
+        ]
+        assert counts == [4, 3, 3]
+
+    def test_synthetic_generator(self, tmp_path):
+        paths = libsvm.generate_synthetic_ctr(
+            str(tmp_path), num_files=2, examples_per_file=8,
+            feature_size=100, field_size=4)
+        assert len(paths) == 2
+        recs = tfrecord.read_all_records(paths[0])
+        assert len(recs) == 8
+        label, ids, vals = example_codec.decode_ctr_example(recs[0], 4)
+        assert label in (0.0, 1.0)
+        assert ids.max() < 100
+
+
+class TestShardPolicy:
+    FILES = [f"f{i}" for i in range(8)]
+
+    def test_single_worker_identity(self):
+        s = sharding.shard_files(self.FILES)
+        assert s.files == tuple(sorted(self.FILES))
+
+    def test_global_shard_covers(self):
+        specs = [
+            sharding.shard_files(self.FILES, rank=r, world_size=4)
+            for r in range(4)
+        ]
+        sharding.validate_shard_coverage(specs, self.FILES)
+        assert all(len(s.files) == 2 for s in specs)
+
+    def test_record_fallback_when_few_files(self):
+        s = sharding.shard_files(["only"], rank=2, world_size=4)
+        assert s.files == ("only",)
+        assert s.record_shard == (4, 2)
+        assert [s.shard_records(i) for i in range(8)] == [
+            False, False, True, False, False, False, True, False]
+
+    def test_s3_shard_splits_by_local_rank(self):
+        specs = [
+            sharding.shard_files(
+                self.FILES, enable_s3_shard=True, local_rank=lr,
+                rank=lr, world_size=8, workers_per_host=4)
+            for lr in range(4)
+        ]
+        sharding.validate_shard_coverage(specs, self.FILES)
+
+    def test_multi_path_no_shard(self):
+        s = sharding.shard_files(
+            self.FILES, enable_data_multi_path=True, rank=3, world_size=4)
+        assert s.files == tuple(sorted(self.FILES))
+
+
+class TestPipeline:
+    @pytest.fixture()
+    def data_dir(self, tmp_path):
+        libsvm.generate_synthetic_ctr(
+            str(tmp_path), num_files=3, examples_per_file=50,
+            feature_size=200, field_size=6, seed=1)
+        return tmp_path
+
+    def _files(self, data_dir):
+        return sorted(str(p) for p in data_dir.glob("*.tfrecords"))
+
+    def test_shapes_and_count(self, data_dir):
+        p = pipeline.CtrPipeline(
+            self._files(data_dir), field_size=6, batch_size=32,
+            num_epochs=1, seed=7, use_native_decoder=False)
+        batches = list(p)
+        assert len(batches) == 150 // 32
+        b = batches[0]
+        assert b["feat_ids"].shape == (32, 6) and b["feat_ids"].dtype == np.int32
+        assert b["feat_vals"].shape == (32, 6) and b["feat_vals"].dtype == np.float32
+        assert b["label"].shape == (32, 1)
+
+    def test_no_drop_remainder(self, data_dir):
+        p = pipeline.CtrPipeline(
+            self._files(data_dir), field_size=6, batch_size=32,
+            drop_remainder=False, use_native_decoder=False)
+        batches = list(p)
+        assert sum(b["label"].shape[0] for b in batches) == 150
+        assert batches[-1]["label"].shape[0] == 150 % 32
+
+    def test_epochs_multiply(self, data_dir):
+        p = pipeline.CtrPipeline(
+            self._files(data_dir), field_size=6, batch_size=50,
+            num_epochs=3, shuffle=False, use_native_decoder=False)
+        assert len(list(p)) == 9
+
+    def test_deterministic_given_seed(self, data_dir):
+        def run():
+            p = pipeline.CtrPipeline(
+                self._files(data_dir), field_size=6, batch_size=16,
+                seed=5, use_native_decoder=False, prefetch_batches=0)
+            return [b["feat_ids"] for b in p]
+        a, b = run(), run()
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+    def test_shuffle_changes_order_across_epochs(self, data_dir):
+        p = pipeline.CtrPipeline(
+            self._files(data_dir), field_size=6, batch_size=150,
+            num_epochs=2, shuffle=True, shuffle_buffer=1000, seed=3,
+            drop_remainder=False, use_native_decoder=False)
+        e1, e2 = list(p)
+        assert not np.array_equal(e1["feat_ids"], e2["feat_ids"])
+        # same multiset of examples
+        assert (sorted(map(tuple, e1["feat_ids"].tolist()))
+                == sorted(map(tuple, e2["feat_ids"].tolist())))
+
+    def test_sharded_pipelines_partition_data(self, data_dir):
+        files = self._files(data_dir)
+        seen = []
+        for r in range(3):
+            spec = sharding.shard_files(files, rank=r, world_size=3)
+            p = pipeline.CtrPipeline(
+                files, field_size=6, batch_size=10, shard=spec,
+                shuffle=False, shuffle_files=False, drop_remainder=False,
+                use_native_decoder=False)
+            for b in p:
+                seen.extend(map(tuple, b["feat_ids"].tolist()))
+        assert len(seen) == 150
+        assert len(set(seen)) == len(seen)  # disjoint coverage
+
+    def test_streaming_single_pass(self, data_dir):
+        files = self._files(data_dir)
+        raw = b"".join(open(f, "rb").read() for f in files)
+        sp = pipeline.StreamingCtrPipeline(
+            io.BytesIO(raw), field_size=6, batch_size=25,
+            use_native_decoder=False)
+        assert len(list(sp)) == 6
+        with pytest.raises(RuntimeError):
+            list(sp)
+
+    def test_prefetch_propagates_errors(self, tmp_path):
+        bad = str(tmp_path / "bad.tfrecords")
+        open(bad, "wb").write(b"\x01\x02\x03")
+        p = pipeline.CtrPipeline(
+            [bad], field_size=6, batch_size=4, prefetch_batches=2,
+            use_native_decoder=False)
+        with pytest.raises(IOError):
+            list(p)

@@ -1,0 +1,32 @@
+#!/usr/bin/env python
+"""LibSVM -> TFRecord converter CLI (reference: tools/libsvm_to_tfrecord.py).
+
+Usage:
+    python tools/libsvm_to_tfrecord.py --input tr.libsvm --output tr.tfrecords \
+        [--field-size 39] [--num-shards 1]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepfm_tpu.data import libsvm  # noqa: E402
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--input", required=True, help="LibSVM text file")
+    p.add_argument("--output", required=True, help="output TFRecord path")
+    p.add_argument("--field-size", type=int, default=None,
+                   help="validate every line has this many features")
+    p.add_argument("--num-shards", type=int, default=1)
+    args = p.parse_args()
+    n = libsvm.convert_libsvm_file(
+        args.input, args.output, field_size=args.field_size,
+        num_shards=args.num_shards)
+    print(f"wrote {n} records to {args.output} ({args.num_shards} shard(s))")
+
+
+if __name__ == "__main__":
+    main()
