@@ -1,0 +1,23 @@
+"""Model zoo registry: deepfm | widedeep | dcnv2 (BASELINE.json configs)."""
+
+from typing import Union
+
+from ..config import Config
+from .dcnv2 import DCNv2  # noqa: F401
+from .deepfm import DeepFM  # noqa: F401
+from .widedeep import WideDeep  # noqa: F401
+
+_REGISTRY = {
+    "deepfm": DeepFM,
+    "widedeep": WideDeep,
+    "dcnv2": DCNv2,
+}
+
+CtrModel = Union[DeepFM, WideDeep, DCNv2]
+
+
+def get_model(cfg: Config) -> CtrModel:
+    try:
+        return _REGISTRY[cfg.model](cfg)
+    except KeyError:
+        raise ValueError(f"unknown model {cfg.model!r}; have {sorted(_REGISTRY)}")

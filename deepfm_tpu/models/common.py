@@ -1,0 +1,194 @@
+"""Shared model building blocks: initializers, DNN tower with BN/dropout.
+
+Behavioral parity notes (vs reference ``model_fn``, ``1-ps-cpu/...py:149-292``):
+  * Hidden layers: dense -> ReLU -> [BatchNorm] -> [dropout] (BN applied
+    *after* the activation, reference ``:219-221``).
+  * ``dropout`` values are KEEP probabilities (``tf.nn.dropout(keep_prob=...)``
+    reference ``:222``), applied in TRAIN mode only.
+  * Final output layer: dense to 1 with identity activation (``:226``).
+  * Weight init: glorot/Xavier (``glorot_normal_initializer`` for embeddings
+    ``:167-168``; ``fully_connected`` default glorot_uniform for the tower).
+  * Only FM_W / FM_V carry an effective l2 penalty — the tower's regularizer
+    losses were never added to the loss in the reference (TF1 collection not
+    collected), so the tower here has none.
+
+TPU-first: tower matmuls run in ``compute_dtype`` (bfloat16 by default) with
+float32 params and float32 loss; BN statistics are float32. Under data
+parallelism (``data_axis`` set, inside shard_map) BatchNorm uses
+*cross-replica* statistics via pmean — a deliberate improvement over the
+reference's per-worker BN stats (deterministic w.r.t. world size).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+State = Dict[str, Any]
+
+
+def glorot_normal(rng: jax.Array, shape: Sequence[int],
+                  dtype: jnp.dtype = jnp.float32) -> jax.Array:
+    fan_in, fan_out = _fans(shape)
+    std = (2.0 / (fan_in + fan_out)) ** 0.5
+    return std * jax.random.normal(rng, shape, dtype)
+
+
+def glorot_uniform(rng: jax.Array, shape: Sequence[int],
+                   dtype: jnp.dtype = jnp.float32) -> jax.Array:
+    fan_in, fan_out = _fans(shape)
+    limit = (6.0 / (fan_in + fan_out)) ** 0.5
+    return jax.random.uniform(rng, shape, dtype, -limit, limit)
+
+
+def _fans(shape: Sequence[int]) -> Tuple[int, int]:
+    if len(shape) < 1:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    recep = 1
+    for s in shape[:-2]:
+        recep *= s
+    return shape[-2] * recep, shape[-1] * recep
+
+
+# ---------------------------------------------------------------------------
+# BatchNorm (running-stats state; reference batch_norm_layer :286-291)
+# ---------------------------------------------------------------------------
+
+
+def batch_norm(
+    h32: jnp.ndarray,
+    scale: jnp.ndarray,
+    bias: jnp.ndarray,
+    bn_state: State,
+    *,
+    train: bool,
+    decay: float,
+    data_axis: Optional[str] = None,
+    eps: float = 1e-3,
+) -> Tuple[jnp.ndarray, State]:
+    """Normalize h32 [B, D] (float32). Returns (normalized, new_bn_state)."""
+    if train:
+        mean = jnp.mean(h32, axis=0)
+        mean_sq = jnp.mean(jnp.square(h32), axis=0)
+        if data_axis is not None:
+            mean = jax.lax.pmean(mean, data_axis)
+            mean_sq = jax.lax.pmean(mean_sq, data_axis)
+        var = mean_sq - jnp.square(mean)
+        new_state = {
+            "mean": decay * bn_state["mean"] + (1 - decay) * mean,
+            "var": decay * bn_state["var"] + (1 - decay) * var,
+        }
+    else:
+        mean, var = bn_state["mean"], bn_state["var"]
+        new_state = bn_state
+    out = (h32 - mean) * jax.lax.rsqrt(var + eps) * scale + bias
+    return out, new_state
+
+
+# ---------------------------------------------------------------------------
+# DNN tower
+# ---------------------------------------------------------------------------
+
+
+def init_hidden_stack(rng: jax.Array, in_dim: int, layer_sizes: Sequence[int],
+                      use_bn: bool) -> Tuple[Params, State]:
+    params: Params = {"layers": []}
+    state: State = {"bn": []}
+    dims = [in_dim] + list(layer_sizes)
+    keys = jax.random.split(rng, max(len(layer_sizes), 1))
+    for i, (d_in, d_out) in enumerate(zip(dims[:-1], dims[1:])):
+        layer = {
+            "w": glorot_uniform(keys[i], (d_in, d_out)),
+            "b": jnp.zeros((d_out,), jnp.float32),
+        }
+        if use_bn:
+            layer["bn_scale"] = jnp.ones((d_out,), jnp.float32)
+            layer["bn_bias"] = jnp.zeros((d_out,), jnp.float32)
+            state["bn"].append({
+                "mean": jnp.zeros((d_out,), jnp.float32),
+                "var": jnp.ones((d_out,), jnp.float32),
+            })
+        params["layers"].append(layer)
+    return params, state
+
+
+def apply_hidden_stack(
+    params: Params,
+    state: State,
+    x: jnp.ndarray,
+    *,
+    train: bool,
+    dropout_keep: Sequence[float],
+    use_bn: bool,
+    bn_decay: float,
+    rng: Optional[jax.Array],
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+    data_axis: Optional[str] = None,
+) -> Tuple[jnp.ndarray, State]:
+    """dense->relu->[BN]->[dropout] stack. x: [B, D_in] -> ([B, D_last], state)."""
+    new_state: State = {"bn": []}
+    h = x.astype(compute_dtype)
+    n_layers = len(params["layers"])
+    if train and rng is not None and n_layers:
+        drop_keys = list(jax.random.split(rng, n_layers))
+    else:
+        drop_keys = [None] * n_layers
+    for i, layer in enumerate(params["layers"]):
+        h = h @ layer["w"].astype(compute_dtype) + layer["b"].astype(compute_dtype)
+        h = jax.nn.relu(h)
+        if use_bn:
+            h32, bn_new = batch_norm(
+                h.astype(jnp.float32), layer["bn_scale"], layer["bn_bias"],
+                state["bn"][i], train=train, decay=bn_decay, data_axis=data_axis)
+            new_state["bn"].append(bn_new)
+            h = h32.astype(compute_dtype)
+        keep = dropout_keep[i] if i < len(dropout_keep) else 1.0
+        if train and keep < 1.0 and drop_keys[i] is not None:
+            mask = jax.random.bernoulli(drop_keys[i], keep, h.shape)
+            h = jnp.where(mask, h / keep, jnp.zeros((), h.dtype))
+    return h, new_state
+
+
+def init_tower(rng: jax.Array, in_dim: int, layer_sizes: Sequence[int],
+               use_bn: bool) -> Tuple[Params, State]:
+    """Hidden stack + final dense->1. Returns (params, bn_state)."""
+    k_stack, k_out = jax.random.split(rng)
+    params, state = init_hidden_stack(k_stack, in_dim, layer_sizes, use_bn)
+    last = layer_sizes[-1] if layer_sizes else in_dim
+    params["out"] = {
+        "w": glorot_uniform(k_out, (last, 1)),
+        "b": jnp.zeros((1,), jnp.float32),
+    }
+    return params, state
+
+
+def apply_tower(
+    params: Params,
+    state: State,
+    x: jnp.ndarray,
+    *,
+    train: bool,
+    dropout_keep: Sequence[float],
+    use_bn: bool,
+    bn_decay: float,
+    rng: Optional[jax.Array],
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+    data_axis: Optional[str] = None,
+) -> Tuple[jnp.ndarray, State]:
+    """Run hidden stack + output head. x: [B, D] -> ([B], new_bn_state)."""
+    h, new_state = apply_hidden_stack(
+        params, state, x, train=train, dropout_keep=dropout_keep, use_bn=use_bn,
+        bn_decay=bn_decay, rng=rng, compute_dtype=compute_dtype,
+        data_axis=data_axis)
+    out = h @ params["out"]["w"].astype(h.dtype) + params["out"]["b"].astype(h.dtype)
+    return out.astype(jnp.float32)[:, 0], new_state
+
+
+def l2_half_sum(x: jnp.ndarray) -> jnp.ndarray:
+    """tf.nn.l2_loss semantics: 0.5 * sum(x^2) (reference loss ``:244-246``)."""
+    return 0.5 * jnp.sum(jnp.square(x.astype(jnp.float32)))

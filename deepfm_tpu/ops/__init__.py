@@ -1,0 +1,1 @@
+from . import embedding, fm  # noqa: F401

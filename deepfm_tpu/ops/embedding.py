@@ -1,0 +1,63 @@
+"""Embedding lookup ops — plain and row-sharded.
+
+The reference keeps embedding tables either wholly on the parameter server
+(``1-ps-cpu/...py:166-168``; every lookup crosses the gRPC wire) or fully
+replicated per GPU (Horovod). The TPU-native design row-shards the table
+across the ``model`` mesh axis and turns each lookup into a *dense*
+local-gather + mask + ``psum`` — one ICI collective, no host round-trips
+(SURVEY.md Stage 3; the mask-and-psum keeps shapes static for XLA).
+
+``sharded_lookup`` is written to run inside ``shard_map`` where ``table`` is
+the local shard and ``ids`` are the (replicated-over-model) global indices.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def lookup(table: jax.Array, ids: jax.Array, *, axis_name: Optional[str] = None) -> jax.Array:
+    """Gather rows of ``table`` at ``ids``.
+
+    table: [V, ...] (or local shard [V/m, ...] inside shard_map)
+    ids:   int32 [...]
+    Returns [..., *table.shape[1:]] (f32), summed over ``axis_name`` shards
+    when given.
+    """
+    if axis_name is None:
+        return jnp.take(table, ids, axis=0)
+    return sharded_lookup(table, ids, axis_name)
+
+
+def sharded_lookup(local_table: jax.Array, ids: jax.Array, axis_name: str) -> jax.Array:
+    """Row-sharded gather: local masked take + psum over the shard axis.
+
+    Each shard owns rows ``[idx*rows_local, (idx+1)*rows_local)``. Out-of-range
+    ids contribute zeros; the psum reassembles the full gather. O(shards)
+    redundant local gathers, but fully dense and XLA/ICI-friendly.
+    """
+    idx = jax.lax.axis_index(axis_name)
+    rows_local = local_table.shape[0]
+    local_ids = ids.astype(jnp.int32) - idx * rows_local
+    in_range = (local_ids >= 0) & (local_ids < rows_local)
+    safe = jnp.clip(local_ids, 0, rows_local - 1)
+    emb = jnp.take(local_table, safe, axis=0)
+    mask = in_range
+    if local_table.ndim > 1:
+        mask = jnp.expand_dims(in_range, tuple(range(ids.ndim, emb.ndim)))
+    emb = jnp.where(mask, emb, jnp.zeros((), emb.dtype))
+    return jax.lax.psum(emb, axis_name)
+
+
+def padded_vocab(feature_size: int, num_shards: int) -> int:
+    """Round the vocabulary up so the table divides evenly across shards.
+
+    Padding rows are zero-initialized and unreachable from real ids, so they
+    stay exactly zero under training (zero data gradient; l2 gradient of a
+    zero row is zero)."""
+    if num_shards <= 1:
+        return feature_size
+    return ((feature_size + num_shards - 1) // num_shards) * num_shards

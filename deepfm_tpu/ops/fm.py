@@ -1,0 +1,22 @@
+"""FM second-order interaction op.
+
+The O(F*K) factorization-machine identity (reference ``1-ps-cpu/...py:181-187``):
+
+    y_v[b] = 0.5 * sum_k [ (sum_f v[b,f,k]*x[b,f])^2 - sum_f (v[b,f,k]*x[b,f])^2 ]
+
+``fm_interaction`` is the XLA-fused formulation (reduce/square ops fuse into
+one HBM pass); ``deepfm_tpu.ops.pallas_fm`` provides a hand-fused Pallas
+kernel for the combined first+second-order path, selected by the model when
+running on TPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def fm_interaction(xv: jnp.ndarray) -> jnp.ndarray:
+    """xv: [B, F, K] = embeddings * feature values. Returns [B]."""
+    sum_sq = jnp.square(jnp.sum(xv, axis=1))      # [B, K]
+    sq_sum = jnp.sum(jnp.square(xv), axis=1)      # [B, K]
+    return 0.5 * jnp.sum(sum_sq - sq_sum, axis=1)  # [B]

@@ -1,0 +1,2 @@
+from . import bootstrap, mesh  # noqa: F401
+from .mesh import MeshInfo, build_mesh, param_pspecs  # noqa: F401

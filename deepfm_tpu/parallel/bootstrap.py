@@ -1,0 +1,60 @@
+"""Multi-process bootstrap: the L4 cluster-topology layer, TPU-native.
+
+The reference consumed SageMaker's TF_CONFIG/SM_* contract and shipped a
+vestigial local bootstrap (`set_dist_env`, 1-ps-cpu/...py:294-339) that
+hand-built TF_CONFIG with chief/evaluator role rewriting. On TPU none of that
+role machinery exists: every process is symmetric SPMD. This module wraps
+``jax.distributed.initialize`` and exposes rank helpers; "chief" semantics
+(rank-0-only checkpoint/export, reference 2-hvd-gpu/...py:365-368) map to
+``is_chief()``.
+
+dist_mode (Config):
+  0 — single process (auto-init if TPU env provides topology)
+  1 — local multi-process test cluster: processes rendezvous on
+      ``coordinator_address`` with explicit num_processes/process_id
+      (the `set_dist_env` analog, for CPU multi-process tests)
+  2 — managed cluster (GKE/TPU VM): jax.distributed.initialize() discovers
+      topology from the environment
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..config import Config
+
+_INITIALIZED = False
+
+
+def initialize(cfg: Config) -> None:
+    """Idempotent jax.distributed bootstrap per cfg.dist_mode."""
+    global _INITIALIZED
+    if _INITIALIZED or cfg.dist_mode == 0:
+        return
+    if cfg.dist_mode == 1:
+        if not cfg.coordinator_address:
+            raise ValueError("dist_mode=1 requires coordinator_address")
+        jax.distributed.initialize(
+            coordinator_address=cfg.coordinator_address,
+            num_processes=cfg.num_processes,
+            process_id=cfg.process_id,
+        )
+    elif cfg.dist_mode == 2:
+        jax.distributed.initialize()
+    else:
+        raise ValueError(f"unknown dist_mode {cfg.dist_mode}")
+    _INITIALIZED = True
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_chief() -> bool:
+    """Rank-0 semantics: checkpoint/eval/export only on the chief process
+    (reference rank-0-only model_dir, 2-hvd-gpu/...py:365-368)."""
+    return jax.process_index() == 0

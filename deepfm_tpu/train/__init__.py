@@ -1,0 +1,3 @@
+from . import loop, metrics, optimizers  # noqa: F401
+from .loop import Trainer  # noqa: F401
+from .state import TrainState  # noqa: F401

@@ -1,0 +1,340 @@
+"""Trainer: jitted/shard_mapped train-eval-predict step functions + fit loop.
+
+TPU-native replacement for the reference's Estimator driver (L3):
+
+  * One *synchronous SPMD* mechanism replaces both reference backends: the
+    step function is ``shard_map``-ped over the ``('data','model')`` mesh —
+    gradients are ``pmean``-ed over 'data' (vs Horovod's NCCL ring allreduce,
+    X2) and embedding lookups are masked-gather + ``psum`` over 'model'
+    row-shards (vs the gRPC parameter server, X1). On one device it's a plain
+    ``jax.jit``.
+  * Replicated initialization from one PRNG key == Horovod's
+    ``BroadcastGlobalVariablesHook(0)`` (reference 2-hvd-gpu/...py:372).
+  * Everything under jit is static-shaped; one compiled program per task.
+
+The fit loop feeds host batches via ``jax.make_array_from_process_local_data``
+(multi-host-correct) and logs loss/examples-per-sec every ``log_steps``
+(reference flag :47).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Iterable, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from ..config import Config
+from ..models import get_model
+from ..parallel import mesh as mesh_lib
+from ..utils import logging as ulog
+from . import metrics as metrics_lib
+from . import optimizers as opt_lib
+from .state import TrainState
+
+
+class Trainer:
+    """Builds and runs the compiled train/eval/predict step functions."""
+
+    def __init__(self, cfg: Config, mesh_info: Optional[mesh_lib.MeshInfo] = None):
+        self.cfg = cfg
+        self.model = get_model(cfg)
+        self.mesh_info = mesh_info if mesh_info is not None else mesh_lib.build_mesh(cfg)
+        self.tx = opt_lib.build_optimizer(cfg, world_size=self.mesh_info.data_size)
+        self._specs: Optional[Dict[str, Any]] = None
+        self._train_step: Optional[Callable] = None
+        self._eval_step: Optional[Callable] = None
+        self._predict_step: Optional[Callable] = None
+
+    # ------------------------------------------------------------------
+    # State creation / placement
+    # ------------------------------------------------------------------
+    def init_state(self, seed: Optional[int] = None) -> TrainState:
+        """Replicated-by-construction init: every process derives identical
+        params from the same seed (broadcast-hook analog)."""
+        seed = self.cfg.seed if seed is None else seed
+        rng = jax.random.PRNGKey(seed)
+        k_init, k_state = jax.random.split(rng)
+        params, model_state = self.model.init(k_init)
+        opt_state = self.tx.init(params)
+        state = TrainState.create(params, opt_state, model_state, k_state)
+        return self._place(state)
+
+    def _state_specs(self, state: TrainState) -> TrainState:
+        param_specs = mesh_lib.param_pspecs(
+            state.params, self.model.embedding_param_names(),
+            self.mesh_info.model_size)
+        opt_specs = mesh_lib.opt_state_pspecs(
+            state.opt_state, state.params, param_specs)
+        mstate_specs = jax.tree.map(lambda _: P(), state.model_state)
+        return TrainState(
+            step=P(), params=param_specs, opt_state=opt_specs,
+            model_state=mstate_specs, rng=P())
+
+    def _place(self, state: TrainState) -> TrainState:
+        """Apply NamedShardings (row-sharded embeddings, replicated rest)."""
+        mi = self.mesh_info
+        if mi.mesh is None:
+            return jax.device_put(state)
+        specs = self._state_specs(state)
+        return jax.tree.map(
+            lambda x, s: jax.device_put(x, mi.sharding(s)), state, specs)
+
+    def put_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
+        """Host numpy batch -> device array sharded over the data axis.
+
+        Under multi-process each process passes its local shard of the global
+        batch; ``make_array_from_process_local_data`` assembles the global
+        array (the pod-sharded tf.data->device-iterator analog, X3)."""
+        mi = self.mesh_info
+        if mi.mesh is None:
+            return jax.device_put(batch)
+        return jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(
+                mi.sharding(P(mesh_lib.DATA_AXIS, *([None] * (x.ndim - 1)))), x),
+            dict(batch))
+
+    # ------------------------------------------------------------------
+    # Step functions
+    # ------------------------------------------------------------------
+    def _loss_terms(self, params, model_state, batch, *, train, rng,
+                    shard_axis, data_axis):
+        logits, new_mstate = self.model.apply(
+            params, model_state, batch["feat_ids"], batch["feat_vals"],
+            train=train, rng=rng, shard_axis=shard_axis, data_axis=data_axis)
+        labels = batch["label"].reshape(-1).astype(jnp.float32)
+        if self.cfg.loss_type == "log_loss":
+            xent = jnp.mean(optax.sigmoid_binary_cross_entropy(logits, labels))
+        else:  # square_loss (reference flag loss_type)
+            xent = jnp.mean(jnp.square(jax.nn.sigmoid(logits) - labels))
+        return logits, xent, new_mstate
+
+    def _make_train_step(self) -> Callable:
+        mi = self.mesh_info
+        shard_axis = mi.model_axis if mi.model_size > 1 else None
+        data_axis = mi.data_axis
+
+        def step(state: TrainState, batch) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
+            rng = jax.random.fold_in(state.rng, state.step)
+            if data_axis is not None:
+                # Distinct dropout per data shard; identical across model
+                # shards (keeps activations replicated over 'model').
+                rng = jax.random.fold_in(rng, jax.lax.axis_index(data_axis))
+
+            def loss_fn(params):
+                _, xent, new_mstate = self._loss_terms(
+                    params, state.model_state, batch, train=True, rng=rng,
+                    shard_axis=shard_axis, data_axis=data_axis)
+                if data_axis is not None:
+                    # THE gradient sync point: the loss is made a *global*
+                    # scalar (mean over the data axis); differentiating it
+                    # under shard_map's replication-aware AD yields gradients
+                    # with the cross-replica psum already inserted by XLA —
+                    # this replaces hvd.DistributedOptimizer's NCCL allreduce
+                    # (2-hvd-gpu/...py:262) and the PS push/pull (X1).
+                    xent = jax.lax.pmean(xent, data_axis)
+                l2 = self.model.l2_loss(params)
+                if shard_axis is not None:
+                    # l2 over the full row-sharded table (invariant scalar).
+                    l2 = jax.lax.psum(l2, shard_axis)
+                return xent + l2, (xent, l2, new_mstate)
+
+            (_, (xent, l2, new_mstate)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params)
+            updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            new_state = state.replace(
+                step=state.step + 1, params=new_params, opt_state=new_opt,
+                model_state=new_mstate)
+            return new_state, {"loss": xent + l2, "xent": xent}
+
+        if mi.mesh is None:
+            return jax.jit(step, donate_argnums=0)
+        specs = self._dummy_specs()
+        return jax.jit(
+            shard_map(
+                step, mesh=mi.mesh,
+                in_specs=(specs["state"], specs["batch"]),
+                out_specs=(specs["state"], P()),
+                check_vma=True),
+            donate_argnums=0)
+
+    def _make_eval_step(self) -> Callable:
+        mi = self.mesh_info
+        shard_axis = mi.model_axis if mi.model_size > 1 else None
+        data_axis = mi.data_axis
+
+        def step(state: TrainState, batch, acc):
+            auc_state, loss_state = acc
+            logits, xent, _ = self._loss_terms(
+                state.params, state.model_state, batch, train=False, rng=None,
+                shard_axis=shard_axis, data_axis=data_axis)
+            probs = jax.nn.sigmoid(logits)
+            labels = batch["label"].reshape(-1)
+            delta = metrics_lib.auc_update(
+                metrics_lib.auc_init(self.cfg.auc_num_thresholds), probs, labels)
+            n = jnp.float32(probs.shape[0])
+            loss_total = xent * n
+            if data_axis is not None:
+                delta = metrics_lib.auc_psum(delta, data_axis)
+                loss_total = jax.lax.psum(loss_total, data_axis)
+                n = jax.lax.psum(n, data_axis)
+            new_auc = metrics_lib.auc_merge(auc_state, delta)
+            new_loss = metrics_lib.MeanState(
+                total=loss_state.total + loss_total, count=loss_state.count + n)
+            return (new_auc, new_loss)
+
+        if mi.mesh is None:
+            return jax.jit(step)
+        specs = self._dummy_specs()
+        return jax.jit(shard_map(
+            step, mesh=mi.mesh,
+            in_specs=(specs["state"], specs["batch"], P()),
+            out_specs=P(),
+            check_vma=True))
+
+    def _make_predict_step(self) -> Callable:
+        mi = self.mesh_info
+        shard_axis = mi.model_axis if mi.model_size > 1 else None
+
+        def step(state: TrainState, batch):
+            logits, _ = self.model.apply(
+                state.params, state.model_state, batch["feat_ids"],
+                batch["feat_vals"], train=False, rng=None,
+                shard_axis=shard_axis, data_axis=mi.data_axis)
+            return jax.nn.sigmoid(logits)
+
+        if mi.mesh is None:
+            return jax.jit(step)
+        specs = self._dummy_specs()
+        return jax.jit(shard_map(
+            step, mesh=mi.mesh,
+            in_specs=(specs["state"], specs["batch"]),
+            out_specs=P(mesh_lib.DATA_AXIS),
+            check_vma=True))
+
+    def _dummy_specs(self) -> Dict[str, Any]:
+        if self._specs is None:
+            # Build spec trees from an abstract state (no device memory).
+            abstract = jax.eval_shape(
+                lambda: self._abstract_state_for_specs())
+            state_specs = self._state_specs(abstract)
+            batch = {
+                "feat_ids": jax.ShapeDtypeStruct(
+                    (self.cfg.batch_size, self.cfg.field_size), jnp.int32),
+                "feat_vals": jax.ShapeDtypeStruct(
+                    (self.cfg.batch_size, self.cfg.field_size), jnp.float32),
+                "label": jax.ShapeDtypeStruct(
+                    (self.cfg.batch_size, 1), jnp.float32),
+            }
+            self._specs = {
+                "state": state_specs,
+                "batch": mesh_lib.batch_pspecs(batch),
+            }
+        return self._specs
+
+    def _abstract_state_for_specs(self) -> TrainState:
+        rng = jax.random.PRNGKey(0)
+        params, model_state = self.model.init(rng)
+        opt_state = self.tx.init(params)
+        return TrainState.create(params, opt_state, model_state, rng)
+
+    # ------------------------------------------------------------------
+    # Public API
+    # ------------------------------------------------------------------
+    @property
+    def train_step(self) -> Callable:
+        if self._train_step is None:
+            self._train_step = self._make_train_step()
+        return self._train_step
+
+    @property
+    def eval_step(self) -> Callable:
+        if self._eval_step is None:
+            self._eval_step = self._make_eval_step()
+        return self._eval_step
+
+    @property
+    def predict_step(self) -> Callable:
+        if self._predict_step is None:
+            self._predict_step = self._make_predict_step()
+        return self._predict_step
+
+    def fit(
+        self,
+        state: TrainState,
+        batches: Iterable[Dict[str, np.ndarray]],
+        *,
+        hooks: Optional[list] = None,
+        max_steps: Optional[int] = None,
+    ) -> Tuple[TrainState, Dict[str, float]]:
+        """Run the train loop over an iterable of host batches."""
+        cfg = self.cfg
+        step_fn = self.train_step
+        last_loss = float("nan")
+        t0 = time.time()
+        examples_since_log = 0
+        n_steps = 0
+        for batch in batches:
+            dev_batch = self.put_batch(batch)
+            state, m = step_fn(state, dev_batch)
+            n_steps += 1
+            examples_since_log += batch["label"].shape[0] * (
+                jax.process_count() if self.mesh_info.mesh is not None else 1)
+            step_now = n_steps
+            if cfg.log_steps and step_now % cfg.log_steps == 0:
+                loss = float(m["loss"])
+                last_loss = loss
+                dt = time.time() - t0
+                eps = examples_since_log / max(dt, 1e-9)
+                ulog.info(
+                    f"step={int(state.step)} loss={loss:.5f} "
+                    f"examples/sec={eps:,.0f}")
+                t0 = time.time()
+                examples_since_log = 0
+            for hook in hooks or []:
+                hook(state, m)
+            if max_steps is not None and n_steps >= max_steps:
+                break
+        if np.isnan(last_loss) and n_steps:
+            last_loss = float(m["loss"])
+        return state, {"loss": last_loss, "steps": float(n_steps)}
+
+    def evaluate(
+        self,
+        state: TrainState,
+        batches: Iterable[Dict[str, np.ndarray]],
+    ) -> Dict[str, float]:
+        """Streaming eval: AUC (reference's sole metric, :249-251) + mean loss."""
+        acc = (metrics_lib.auc_init(self.cfg.auc_num_thresholds),
+               metrics_lib.mean_init())
+        acc = jax.device_put(acc)
+        step_fn = self.eval_step
+        n = 0
+        for batch in batches:
+            acc = step_fn(state, self.put_batch(batch), acc)
+            n += 1
+        if n == 0:
+            return {"auc": 0.0, "loss": 0.0, "batches": 0.0}
+        auc_state, loss_state = acc
+        return {
+            "auc": float(metrics_lib.auc_compute(auc_state)),
+            "loss": float(metrics_lib.mean_compute(loss_state)),
+            "batches": float(n),
+        }
+
+    def predict(
+        self,
+        state: TrainState,
+        batches: Iterable[Dict[str, np.ndarray]],
+    ) -> Iterator[np.ndarray]:
+        """Yield per-batch probability vectors (reference infer task :445-449)."""
+        step_fn = self.predict_step
+        for batch in batches:
+            probs = step_fn(state, self.put_batch(batch))
+            yield np.asarray(jax.device_get(probs))

@@ -1,0 +1,117 @@
+"""Streaming, psum-reducible evaluation metrics.
+
+The reference's sole quality metric is ``tf.metrics.auc(labels, pred)``
+(``1-ps-cpu/...py:249-251``) — a streaming *binned* AUC over
+``num_thresholds`` buckets with trapezoidal interpolation. This module
+implements the same approximation as a pure-JAX accumulator whose state is a
+pair of histograms — additive, so cross-host/device reduction is a plain
+``psum`` (SURVEY.md hard-part #2), and jit-compatible (fixed shapes).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class AucState(NamedTuple):
+    """Histogram of prediction scores split by label. Additive under psum."""
+    pos: jnp.ndarray   # f64-safe f32 [num_bins]
+    neg: jnp.ndarray   # [num_bins]
+
+
+def auc_init(num_bins: int = 200) -> AucState:
+    return AucState(pos=jnp.zeros((num_bins,), jnp.float32),
+                    neg=jnp.zeros((num_bins,), jnp.float32))
+
+
+def auc_update(state: AucState, probs: jnp.ndarray, labels: jnp.ndarray,
+               weights: jnp.ndarray | None = None) -> AucState:
+    """Accumulate a batch. probs/labels: [B] or [B,1] in [0,1]."""
+    num_bins = state.pos.shape[0]
+    probs = probs.reshape(-1).astype(jnp.float32)
+    labels = labels.reshape(-1).astype(jnp.float32)
+    w = jnp.ones_like(probs) if weights is None else weights.reshape(-1).astype(jnp.float32)
+    bins = jnp.clip((probs * num_bins).astype(jnp.int32), 0, num_bins - 1)
+    pos = state.pos + jnp.zeros_like(state.pos).at[bins].add(w * labels)
+    neg = state.neg + jnp.zeros_like(state.neg).at[bins].add(w * (1.0 - labels))
+    return AucState(pos=pos, neg=neg)
+
+
+def auc_merge(a: AucState, b: AucState) -> AucState:
+    return AucState(pos=a.pos + b.pos, neg=a.neg + b.neg)
+
+
+def auc_psum(state: AucState, axis_name: str) -> AucState:
+    return AucState(pos=jax.lax.psum(state.pos, axis_name),
+                    neg=jax.lax.psum(state.neg, axis_name))
+
+
+def auc_compute(state: AucState) -> jnp.ndarray:
+    """Trapezoidal AUC over the ROC curve swept across bin thresholds.
+
+    Threshold k = "predict positive iff score >= bin k"; TPR/FPR from suffix
+    sums of the histograms; trapezoid over consecutive thresholds — the same
+    estimator family as tf.metrics.auc(curve='ROC',
+    summation_method='trapezoidal').
+    """
+    total_pos = jnp.sum(state.pos)
+    total_neg = jnp.sum(state.neg)
+    # Suffix cumulative: tp[k] = #pos with bin >= k; include k=0 (all) and
+    # k=num_bins (none) endpoints.
+    tp = jnp.concatenate([jnp.cumsum(state.pos[::-1])[::-1], jnp.zeros((1,))])
+    fp = jnp.concatenate([jnp.cumsum(state.neg[::-1])[::-1], jnp.zeros((1,))])
+    tpr = tp / jnp.maximum(total_pos, 1.0)
+    fpr = fp / jnp.maximum(total_neg, 1.0)
+    # ROC swept from threshold high->low is (fpr,tpr) increasing; integrate.
+    auc = jnp.sum((fpr[:-1] - fpr[1:]) * (tpr[:-1] + tpr[1:]) * 0.5)
+    return jnp.where((total_pos > 0) & (total_neg > 0), auc, jnp.float32(0.0))
+
+
+class MeanState(NamedTuple):
+    total: jnp.ndarray  # scalar
+    count: jnp.ndarray  # scalar
+
+
+def mean_init() -> MeanState:
+    return MeanState(total=jnp.zeros((), jnp.float32),
+                     count=jnp.zeros((), jnp.float32))
+
+
+def mean_update(state: MeanState, value: jnp.ndarray,
+                count: jnp.ndarray | float = 1.0) -> MeanState:
+    return MeanState(total=state.total + value.astype(jnp.float32) * count,
+                     count=state.count + count)
+
+
+def mean_compute(state: MeanState) -> jnp.ndarray:
+    return state.total / jnp.maximum(state.count, 1.0)
+
+
+def auc_numpy_reference(probs, labels) -> float:
+    """Exact (rank-based) AUC on host — test oracle for the binned estimator."""
+    import numpy as np
+    probs = np.asarray(probs).reshape(-1)
+    labels = np.asarray(labels).reshape(-1)
+    order = np.argsort(probs, kind="mergesort")
+    ranks = np.empty_like(order, dtype=np.float64)
+    ranks[order] = np.arange(1, len(probs) + 1)
+    # average ranks for ties
+    sorted_p = probs[order]
+    i = 0
+    while i < len(sorted_p):
+        j = i
+        while j + 1 < len(sorted_p) and sorted_p[j + 1] == sorted_p[i]:
+            j += 1
+        if j > i:
+            avg = (i + 1 + j + 1) / 2.0
+            ranks[order[i:j + 1]] = avg
+        i = j + 1
+    n_pos = labels.sum()
+    n_neg = len(labels) - n_pos
+    if n_pos == 0 or n_neg == 0:
+        return 0.0
+    return float((ranks[labels == 1].sum() - n_pos * (n_pos + 1) / 2.0)
+                 / (n_pos * n_neg))

@@ -1,0 +1,102 @@
+"""Optimizer zoo matching the reference's four choices + world-size LR scaling.
+
+Reference (``1-ps-cpu/...py:260-269``):
+  Adam(lr, beta1=0.9, beta2=0.999, eps=1e-8)
+  Adagrad(lr, initial_accumulator_value=1e-8)
+  Momentum(lr, momentum=0.95)
+  Ftrl(lr)  — TF defaults: lr_power=-0.5, initial_accumulator=0.1, l1=l2=0
+
+Horovod variant scales lr by world size (``2-hvd-gpu/...py:149``); here that
+is ``scale_lr_by_world`` x the data-axis size of the mesh.
+
+FTRL has no optax built-in; ``ftrl()`` below is a custom
+``GradientTransformation`` implementing FTRL-Proximal (McMahan et al. 2013),
+the same update ``tf.train.FtrlOptimizer`` applies densely.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import chex
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..config import Config
+
+
+class FtrlState(NamedTuple):
+    z: optax.Updates   # per-weight z accumulator
+    n: optax.Updates   # per-weight squared-gradient accumulator
+
+
+def ftrl(
+    learning_rate: float,
+    *,
+    learning_rate_power: float = -0.5,
+    initial_accumulator_value: float = 0.1,
+    l1_regularization_strength: float = 0.0,
+    l2_regularization_strength: float = 0.0,
+    beta: float = 0.0,
+) -> optax.GradientTransformation:
+    """FTRL-Proximal as an optax GradientTransformation (requires params).
+
+    w_new = 0                                  if |z| <= l1
+          = -(z - sign(z)*l1) / ((beta + n_new^(-lr_power))/lr + 2*l2)  else
+    with n_new = n + g^2 and z += g - (n_new^p - n^p)/lr * w, p = -lr_power.
+    """
+    if learning_rate_power > 0:
+        raise ValueError("learning_rate_power must be <= 0")
+    p = -learning_rate_power  # 0.5 for the default sqrt schedule
+
+    def init_fn(params: optax.Params) -> FtrlState:
+        return FtrlState(
+            z=jax.tree.map(jnp.zeros_like, params),
+            n=jax.tree.map(
+                lambda x: jnp.full_like(x, initial_accumulator_value), params),
+        )
+
+    def update_fn(updates, state: FtrlState, params=None):
+        if params is None:
+            raise ValueError("ftrl requires params in update()")
+
+        def leaf(g, z, n, w):
+            g = g.astype(jnp.float32)
+            n_new = n + jnp.square(g)
+            sigma = (jnp.power(n_new, p) - jnp.power(n, p)) / learning_rate
+            z_new = z + g - sigma * w
+            denom = (beta + jnp.power(n_new, p)) / learning_rate \
+                + 2.0 * l2_regularization_strength
+            w_new = jnp.where(
+                jnp.abs(z_new) <= l1_regularization_strength,
+                jnp.zeros_like(w),
+                -(z_new - jnp.sign(z_new) * l1_regularization_strength) / denom)
+            return w_new - w, z_new, n_new
+
+        flat = jax.tree.map(leaf, updates, state.z, state.n, params)
+        deltas = jax.tree.map(lambda t: t[0], flat,
+                              is_leaf=lambda t: isinstance(t, tuple))
+        z_new = jax.tree.map(lambda t: t[1], flat,
+                             is_leaf=lambda t: isinstance(t, tuple))
+        n_new = jax.tree.map(lambda t: t[2], flat,
+                             is_leaf=lambda t: isinstance(t, tuple))
+        return deltas, FtrlState(z=z_new, n=n_new)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def build_optimizer(cfg: Config, *, world_size: int = 1) -> optax.GradientTransformation:
+    lr = cfg.learning_rate
+    if cfg.scale_lr_by_world and world_size > 1:
+        lr = lr * world_size
+    name = cfg.optimizer.lower()
+    if name == "adam":
+        return optax.adam(lr, b1=0.9, b2=0.999, eps=1e-8)
+    if name == "adagrad":
+        return optax.adagrad(lr, initial_accumulator_value=1e-8)
+    if name in ("momentum", "sgd"):
+        return optax.sgd(lr, momentum=0.95 if name == "momentum" else None)
+    if name == "ftrl":
+        return ftrl(lr)
+    raise ValueError(f"unknown optimizer {cfg.optimizer!r}")

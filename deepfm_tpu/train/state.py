@@ -1,0 +1,24 @@
+"""Train state pytree: params + optimizer state + model (BN) state + PRNG."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jnp.ndarray          # int32 scalar (global_step analog)
+    params: Any
+    opt_state: Any
+    model_state: Any           # BatchNorm running stats etc.
+    rng: jax.Array             # base PRNG key; per-step keys are folded in
+
+    @classmethod
+    def create(cls, params: Any, opt_state: Any, model_state: Any,
+               rng: jax.Array) -> "TrainState":
+        return cls(step=jnp.zeros((), jnp.int32), params=params,
+                   opt_state=opt_state, model_state=model_state, rng=rng)

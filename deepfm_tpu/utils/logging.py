@@ -1,0 +1,54 @@
+"""Process-aware logging (chief logs by default; others opt in).
+
+The reference relied on print-based env dumps + tf.logging INFO
+(1-ps-cpu/...py:344-369,470). Here: stdlib logging, rank-prefixed, with
+chief-only default to keep multi-process output readable.
+"""
+
+from __future__ import annotations
+
+import logging as _logging
+import os
+import sys
+
+_LOGGER = None
+_ALL_RANKS = os.environ.get("DEEPFM_LOG_ALL_RANKS", "0") == "1"
+
+
+def get_logger() -> _logging.Logger:
+    global _LOGGER
+    if _LOGGER is None:
+        logger = _logging.getLogger("deepfm_tpu")
+        if not logger.handlers:
+            h = _logging.StreamHandler(sys.stderr)
+            h.setFormatter(_logging.Formatter(
+                "%(asctime)s %(levelname)s deepfm_tpu: %(message)s",
+                datefmt="%H:%M:%S"))
+            logger.addHandler(h)
+        logger.setLevel(_logging.INFO)
+        _LOGGER = logger
+    return _LOGGER
+
+
+def _should_log() -> bool:
+    if _ALL_RANKS:
+        return True
+    try:
+        import jax
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
+def info(msg: str) -> None:
+    if _should_log():
+        get_logger().info(msg)
+
+
+def warning(msg: str) -> None:
+    if _should_log():
+        get_logger().warning(msg)
+
+
+def error(msg: str) -> None:
+    get_logger().error(msg)

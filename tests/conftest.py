@@ -1,17 +1,30 @@
-"""Test configuration: force an 8-device virtual CPU platform BEFORE jax init.
+"""Test configuration: force an 8-device virtual CPU platform.
 
 This is the TPU-native analog of the reference's local-cluster escape hatch
 (`set_dist_env()`, 1-ps-cpu/...py:294-339): distributed semantics are tested
 on one machine by splitting the host CPU into 8 XLA devices.
+
+Note: the environment's sitecustomize eagerly registers the TPU backend, so
+the env var alone is not enough — jax.config must be updated post-import
+(before any CPU client exists) for the override to stick.
 """
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"  # tests never target the real TPU
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    assert len(jax.devices()) == 8, (
+        f"expected 8 virtual CPU devices, got {jax.devices()}")

@@ -1,0 +1,89 @@
+"""Optimizer zoo tests: FTRL math vs a scalar hand-rolled oracle, zoo
+construction, and world-size LR scaling (reference 2-hvd-gpu/...py:149)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deepfm_tpu.config import Config
+from deepfm_tpu.train import optimizers
+
+
+def _scalar_ftrl_oracle(grads, lr=0.1, init_acc=0.1, l1=0.0, l2=0.0, beta=0.0):
+    """Direct FTRL-Proximal recurrence on one scalar weight."""
+    w, z, n = 0.0, 0.0, init_acc
+    ws = []
+    for g in grads:
+        n_new = n + g * g
+        sigma = (np.sqrt(n_new) - np.sqrt(n)) / lr
+        z = z + g - sigma * w
+        n = n_new
+        if abs(z) <= l1:
+            w = 0.0
+        else:
+            w = -(z - np.sign(z) * l1) / ((beta + np.sqrt(n)) / lr + 2 * l2)
+        ws.append(w)
+    return ws
+
+
+def test_ftrl_matches_oracle():
+    tx = optimizers.ftrl(0.1)
+    params = {"w": jnp.zeros(())}
+    state = tx.init(params)
+    grads_seq = [0.5, -0.3, 0.2, 0.9, -1.0]
+    want = _scalar_ftrl_oracle(grads_seq)
+    got = []
+    for g in grads_seq:
+        updates, state = tx.update({"w": jnp.asarray(g)}, state, params)
+        params = optax.apply_updates(params, updates)
+        got.append(float(params["w"]))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_ftrl_l1_sparsifies():
+    tx = optimizers.ftrl(0.5, l1_regularization_strength=10.0)
+    params = {"w": jnp.asarray(0.0)}
+    state = tx.init(params)
+    updates, state = tx.update({"w": jnp.asarray(0.01)}, state, params)
+    params = optax.apply_updates(params, updates)
+    assert float(params["w"]) == 0.0  # |z| below l1 threshold -> exactly zero
+
+
+def test_ftrl_requires_params():
+    tx = optimizers.ftrl(0.1)
+    state = tx.init({"w": jnp.zeros(())})
+    try:
+        tx.update({"w": jnp.asarray(1.0)}, state, None)
+        assert False, "should require params"
+    except ValueError:
+        pass
+
+
+def test_zoo_constructs_and_steps():
+    for name in ["Adam", "Adagrad", "Momentum", "ftrl", "sgd"]:
+        cfg = Config(optimizer=name)
+        tx = optimizers.build_optimizer(cfg)
+        params = {"a": jnp.ones((3,)), "b": jnp.zeros((2, 2))}
+        state = tx.init(params)
+        grads = jax.tree.map(jnp.ones_like, params)
+        updates, _ = tx.update(grads, state, params)
+        new = optax.apply_updates(params, updates)
+        assert not np.allclose(np.asarray(new["a"]), np.asarray(params["a"]))
+
+
+def test_world_size_lr_scaling():
+    """lr x world on the data axis — a plain SGD step shows the factor."""
+    cfg = Config(optimizer="sgd", learning_rate=0.1, scale_lr_by_world=True)
+    tx1 = optimizers.build_optimizer(cfg, world_size=1)
+    tx4 = optimizers.build_optimizer(cfg, world_size=4)
+    params = {"w": jnp.asarray(1.0)}
+    g = {"w": jnp.asarray(1.0)}
+    u1, _ = tx1.update(g, tx1.init(params), params)
+    u4, _ = tx4.update(g, tx4.init(params), params)
+    np.testing.assert_allclose(float(u4["w"]) / float(u1["w"]), 4.0, rtol=1e-6)
+
+    cfg_off = cfg.replace(scale_lr_by_world=False)
+    tx4_off = optimizers.build_optimizer(cfg_off, world_size=4)
+    u4_off, _ = tx4_off.update(g, tx4_off.init(params), params)
+    np.testing.assert_allclose(float(u4_off["w"]), float(u1["w"]), rtol=1e-6)
