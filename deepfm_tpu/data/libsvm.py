@@ -97,17 +97,22 @@ def generate_synthetic_ctr(
     field_size: int,
     prefix: str = "tr",
     seed: int = 0,
+    hidden_seed: int = 12345,
 ) -> List[str]:
     """Write synthetic Criteo-shaped TFRecords with a learnable signal.
 
     Labels follow a logistic model over a hidden random weight vector so AUC
     above 0.5 is achievable — used by integration tests and the benchmark
     harness (reference trained on real Criteo; shape/hparams from
-    ``deepfm-sagemaker-ps-cpu.ipynb:82-90``).
+    ``deepfm-sagemaker-ps-cpu.ipynb:82-90``). ``hidden_seed`` fixes the
+    label-generating model independently of ``seed`` (the example sampler),
+    so train/eval/test splits generated with different seeds share the same
+    ground-truth mapping.
     """
     os.makedirs(out_dir, exist_ok=True)
     rng = np.random.default_rng(seed)
-    hidden_w = rng.normal(0, 1.0, size=feature_size).astype(np.float32)
+    hidden_w = np.random.default_rng(hidden_seed).normal(
+        0, 1.0, size=feature_size).astype(np.float32)
     paths = []
     for fi in range(num_files):
         path = os.path.join(out_dir, f"{prefix}_{fi:04d}.tfrecords")

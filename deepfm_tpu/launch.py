@@ -1,0 +1,30 @@
+"""CLI launcher: ``python -m deepfm_tpu.launch --task_type train ...``
+
+The L5/L3 entry point replacing the SageMaker notebook + ``tf.app.run()``
+pair (reference ``1-ps-cpu/...py:469-471``). All reference hyperparameters
+are accepted as ``--flag value`` argv (the SageMaker hyperparameter-dict
+contract); SageMaker-style env defaults (``SM_CHANNELS`` etc.) are honored
+by ``parse_args``. See ``examples/launch_tpu.md`` for slice-creation recipes.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from .config import parse_args
+from .train import tasks
+from .utils import logging as ulog
+
+
+def main(argv=None) -> int:
+    cfg = parse_args(argv)
+    ulog.info("config: " + json.dumps(cfg.to_dict(), sort_keys=True))
+    result = tasks.run(cfg)
+    ulog.info(f"task {cfg.task_type} finished: {result}")
+    print(json.dumps({"task": cfg.task_type, **result}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

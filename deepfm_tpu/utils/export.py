@@ -1,0 +1,130 @@
+"""Serving export: the SavedModel-analog artifact for TPU-native serving.
+
+The reference exports a SavedModel with a raw serving signature
+``{feat_ids: int64[None,F], feat_vals: float32[None,F]} -> {prob}``
+(``1-ps-cpu/...py:451-467``, PREDICT branch ``:234-241``), chief/rank-0 only.
+
+Here the servable artifact is a directory containing:
+  * ``serving_fn.stablehlo`` — the predict function serialized with
+    ``jax.export`` (StableHLO, batch-dim symbolic, lowered for CPU+TPU)
+  * ``params.ckpt/`` — the inference parameters (Orbax standard format)
+  * ``model_config.json`` — the model hyperparameters + signature schema
+
+``load_serving`` reloads the artifact into a callable — the TF-Serving
+round-trip analog used by tests and the infer benchmark.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import orbax.checkpoint as ocp
+from jax import export as jax_export
+
+from ..config import Config
+from . import logging as ulog
+
+_SERVING_FILE = "serving_fn.stablehlo"
+_PARAMS_DIR = "params.ckpt"
+_CONFIG_FILE = "model_config.json"
+
+
+def _serving_fn(model, cfg: Config) -> Callable:
+    def serve(params, model_state, feat_ids, feat_vals):
+        logits, _ = model.apply(
+            params, model_state, feat_ids.astype(jnp.int32),
+            feat_vals.astype(jnp.float32), train=False, rng=None,
+            shard_axis=None, data_axis=None)
+        return jax.nn.sigmoid(logits)
+    return serve
+
+
+def export_serving(model, state, cfg: Config, out_dir: str) -> str:
+    """Write the servable artifact; returns the artifact path.
+
+    Chief-only by caller convention (reference rank-0 export,
+    ``2-hvd-gpu/...py:429-431``). Params are fetched to host and saved
+    unsharded so any single-device server can load them.
+    """
+    os.makedirs(out_dir, exist_ok=True)
+
+    # 1. Params (device-gathered, unsharded).
+    params = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), state.params)
+    model_state = jax.tree.map(
+        lambda x: np.asarray(jax.device_get(x)), state.model_state)
+    ckptr = ocp.StandardCheckpointer()
+    params_path = os.path.join(os.path.abspath(out_dir), _PARAMS_DIR)
+    ckptr.save(params_path, {"params": params, "model_state": model_state},
+               force=True)
+    ckptr.wait_until_finished()
+
+    # 2. Serialized serving function with symbolic batch dim.
+    serve = _serving_fn(model, cfg)
+    b = jax_export.symbolic_shape("b")[0]
+    ids_spec = jax.ShapeDtypeStruct((b, cfg.field_size), jnp.int32)
+    vals_spec = jax.ShapeDtypeStruct((b, cfg.field_size), jnp.float32)
+    params_spec = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params)
+    mstate_spec = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), model_state)
+    try:
+        exported = jax_export.export(
+            jax.jit(serve), platforms=("cpu", "tpu"))(
+                params_spec, mstate_spec, ids_spec, vals_spec)
+        with open(os.path.join(out_dir, _SERVING_FILE), "wb") as f:
+            f.write(exported.serialize())
+    except Exception as e:  # pragma: no cover - platform-specific lowering
+        ulog.warning(f"stablehlo export skipped ({e}); params-only artifact")
+
+    # 3. Signature/config metadata.
+    meta = {
+        "signature": {
+            "inputs": {
+                "feat_ids": ["batch", cfg.field_size, "int32"],
+                "feat_vals": ["batch", cfg.field_size, "float32"],
+            },
+            "outputs": {"prob": ["batch", "float32"]},
+        },
+        "model": cfg.model,
+        "config": cfg.to_dict(),
+        "step": int(jax.device_get(state.step)),
+    }
+    with open(os.path.join(out_dir, _CONFIG_FILE), "w") as f:
+        json.dump(meta, f, indent=2)
+    ulog.info(f"exported servable model to {out_dir}")
+    return out_dir
+
+
+def load_serving(artifact_dir: str) -> Callable[[np.ndarray, np.ndarray], np.ndarray]:
+    """Reload a servable artifact as ``f(feat_ids, feat_vals) -> probs``."""
+    with open(os.path.join(artifact_dir, _CONFIG_FILE)) as f:
+        meta = json.load(f)
+    cfg = Config.from_dict(meta["config"])
+    ckptr = ocp.StandardCheckpointer()
+    restored = ckptr.restore(os.path.join(os.path.abspath(artifact_dir), _PARAMS_DIR))
+    params, model_state = restored["params"], restored["model_state"]
+
+    hlo_path = os.path.join(artifact_dir, _SERVING_FILE)
+    if os.path.exists(hlo_path):
+        with open(hlo_path, "rb") as f:
+            exported = jax_export.deserialize(f.read())
+
+        def serve(feat_ids: np.ndarray, feat_vals: np.ndarray) -> np.ndarray:
+            return np.asarray(exported.call(
+                params, model_state, feat_ids.astype(np.int32),
+                feat_vals.astype(np.float32)))
+        return serve
+
+    # Fallback: rebuild from config (params-only artifact).
+    from ..models import get_model
+    model = get_model(cfg)
+    fn = jax.jit(_serving_fn(model, cfg))
+
+    def serve(feat_ids: np.ndarray, feat_vals: np.ndarray) -> np.ndarray:
+        return np.asarray(fn(params, model_state, feat_ids, feat_vals))
+    return serve

@@ -1,0 +1,129 @@
+"""End-to-end task tests: train->checkpoint->resume, eval, infer (pred.txt),
+export->load_serving round trip. The integration layer of the test pyramid
+(SURVEY.md §4): exercises the full L1-L5 stack on synthetic Criteo-shaped
+data with the 8-device CPU mesh."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deepfm_tpu.config import Config
+from deepfm_tpu.data import libsvm
+from deepfm_tpu.train import tasks
+from deepfm_tpu.utils import export as export_lib
+
+
+@pytest.fixture(scope="module")
+def workdir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("e2e")
+    data = d / "data"
+    libsvm.generate_synthetic_ctr(
+        str(data), num_files=3, examples_per_file=256,
+        feature_size=300, field_size=5, prefix="tr", seed=7)
+    libsvm.generate_synthetic_ctr(
+        str(data), num_files=1, examples_per_file=256,
+        feature_size=300, field_size=5, prefix="va", seed=8)
+    libsvm.generate_synthetic_ctr(
+        str(data), num_files=1, examples_per_file=128,
+        feature_size=300, field_size=5, prefix="te", seed=9)
+    return d
+
+
+def _cfg(workdir, **kw):
+    base = dict(
+        feature_size=300, field_size=5, embedding_size=8,
+        deep_layers="16,8", dropout="1.0,1.0", batch_size=64,
+        compute_dtype="float32", learning_rate=0.05, num_epochs=2,
+        data_dir=str(workdir / "data"), val_data_dir=str(workdir / "data"),
+        model_dir=str(workdir / "ckpt"), log_steps=0,
+        save_checkpoints_steps=5, mesh_data=4, mesh_model=2,
+        scale_lr_by_world=False, seed=3,
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+class TestTrainTask:
+    def test_train_eval_export_and_resume(self, workdir):
+        cfg = _cfg(workdir, servable_model_dir=str(workdir / "servable"))
+        result = tasks.run(cfg)
+        assert result["auc"] > 0.6, result
+        steps_first = result["steps"]
+        assert steps_first == 2 * (3 * 256 // 64)
+
+        # checkpoints exist
+        assert os.path.isdir(cfg.model_dir)
+        # resume: two more epochs continue from the restored step
+        result2 = tasks.run(_cfg(workdir, num_epochs=1,
+                                 servable_model_dir=""))
+        assert result2["steps"] == steps_first + 3 * 256 // 64
+
+        # servable artifact exists and round-trips
+        sub = os.listdir(str(workdir / "servable"))
+        assert len(sub) == 1
+        artifact = str(workdir / "servable" / sub[0])
+        serve = export_lib.load_serving(artifact)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 300, (16, 5)).astype(np.int32)
+        vals = rng.normal(size=(16, 5)).astype(np.float32)
+        probs = serve(ids, vals)
+        assert probs.shape == (16,)
+        assert ((probs >= 0) & (probs <= 1)).all()
+
+        meta = json.load(open(os.path.join(artifact, "model_config.json")))
+        assert meta["signature"]["inputs"]["feat_ids"] == ["batch", 5, "int32"]
+
+    def test_clear_existing_model(self, workdir):
+        cfg = _cfg(workdir, num_epochs=1, clear_existing_model=True,
+                   model_dir=str(workdir / "ckpt_clear"))
+        tasks.run(cfg)
+        first = tasks.run(cfg)  # cleared -> starts from step 0 again
+        assert first["steps"] == 3 * 256 // 64
+
+
+class TestEvalInferTasks:
+    def test_eval_task(self, workdir):
+        ev = tasks.run(_cfg(workdir, task_type="eval"))
+        assert 0.5 < ev["auc"] <= 1.0
+
+    def test_infer_writes_pred_txt(self, workdir):
+        out = tasks.run(_cfg(workdir, task_type="infer"))
+        assert out["num_predictions"] == 128
+        pred = open(os.path.join(str(workdir / "data"), "pred.txt")).read().split()
+        assert len(pred) == 128
+        vals = np.array([float(p) for p in pred])
+        assert ((vals >= 0) & (vals <= 1)).all()
+
+    def test_export_task(self, workdir):
+        out_dir = str(workdir / "servable2")
+        tasks.run(_cfg(workdir, task_type="export", servable_model_dir=out_dir))
+        sub = os.listdir(out_dir)
+        assert len(sub) == 1
+
+    def test_eval_requires_checkpoint(self, workdir):
+        cfg = _cfg(workdir, task_type="eval", model_dir=str(workdir / "nope"))
+        with pytest.raises(FileNotFoundError):
+            tasks.run(cfg)
+
+
+class TestLaunchCli:
+    def test_cli_roundtrip(self, workdir, capsys):
+        from deepfm_tpu import launch
+        rc = launch.main([
+            "--task_type", "eval",
+            "--data_dir", str(workdir / "data"),
+            "--val_data_dir", str(workdir / "data"),
+            "--model_dir", str(workdir / "ckpt"),
+            "--feature_size", "300", "--field_size", "5",
+            "--embedding_size", "8", "--deep_layers", "16,8",
+            "--dropout", "1.0,1.0", "--batch_size", "64",
+            "--compute_dtype", "float32", "--mesh_data", "4",
+            "--mesh_model", "2", "--log_steps", "0",
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        payload = json.loads(out)
+        assert payload["task"] == "eval"
+        assert payload["auc"] > 0.5
