@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Benchmark harness: DeepFM training throughput on the reference config.
+
+Measures steady-state examples/sec of the full jitted train step (forward +
+backward + Adam update) at the reference benchmark anchors (BASELINE.md):
+feature_size=117581, field_size=39, embedding_size=32, deep_layers 128/64/32,
+global batch 1024, Adam lr 5e-4 — on whatever accelerator JAX exposes (the
+driver runs this on one real TPU chip).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "examples/sec", "vs_baseline": N}
+
+vs_baseline: the reference publishes no numbers (BASELINE.md), so the
+comparison anchor is a documented nominal estimate of the reference Horovod
+recipe: ~250k examples/sec aggregate on the 4xV100 p3.8xlarge (TF1 DeepFM at
+batch 1024/GPU is input/update-bound, not FLOP-bound). Per-accelerator
+baseline = 62.5k examples/sec; vs_baseline = measured_per_chip / 62.5k.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    from deepfm_tpu.config import Config
+    from deepfm_tpu.train import Trainer
+
+    cfg = Config(
+        feature_size=117581,
+        field_size=39,
+        embedding_size=32,
+        deep_layers="128,64,32",
+        dropout="0.5,0.5,0.5",
+        batch_size=1024,
+        learning_rate=5e-4,
+        optimizer="Adam",
+        l2_reg=1e-4,
+        compute_dtype="bfloat16",
+        mesh_data=0,  # all available devices on the data axis
+        mesh_model=1,
+        log_steps=0,
+        seed=0,
+    )
+    n_dev = len(jax.devices())
+    print(f"bench: devices={jax.devices()}", file=sys.stderr)
+
+    trainer = Trainer(cfg)
+    state = trainer.init_state()
+
+    # Pre-staged rotating host batches: measures the device step, with host
+    # batch transfer included but disk/decode excluded (decode is benched
+    # separately; the native decoder sustains >1M ex/s, see tests).
+    rng = np.random.default_rng(0)
+    batches = []
+    for _ in range(8):
+        batches.append({
+            "feat_ids": rng.integers(
+                0, cfg.feature_size, (cfg.batch_size, cfg.field_size)
+            ).astype(np.int32),
+            "feat_vals": rng.normal(
+                size=(cfg.batch_size, cfg.field_size)).astype(np.float32),
+            "label": (rng.random((cfg.batch_size, 1)) < 0.25).astype(np.float32),
+        })
+
+    step = trainer.train_step
+    # Warmup/compile.
+    for i in range(5):
+        state, m = step(state, trainer.put_batch(batches[i % 8]))
+    jax.block_until_ready(m["loss"])
+
+    n_steps = 100
+    t0 = time.perf_counter()
+    for i in range(n_steps):
+        state, m = step(state, trainer.put_batch(batches[i % 8]))
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+
+    total_eps = n_steps * cfg.batch_size / dt
+    per_chip = total_eps / max(n_dev, 1)
+    nominal_per_accel_baseline = 250_000.0 / 4.0
+    result = {
+        "metric": "deepfm_criteo_train_throughput_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "examples/sec",
+        "vs_baseline": round(per_chip / nominal_per_accel_baseline, 3),
+    }
+    print(f"bench: {n_steps} steps in {dt:.3f}s, "
+          f"{1000 * dt / n_steps:.2f} ms/step, total {total_eps:,.0f} ex/s "
+          f"on {n_dev} device(s), loss={float(m['loss']):.4f}",
+          file=sys.stderr)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
