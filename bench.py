@@ -73,12 +73,18 @@ def main() -> None:
         state, m = step(state, trainer.put_batch(batches[i % 8]))
     jax.block_until_ready(m["loss"])
 
+    # Several trials, best wins: at ~0.5 ms/step the host/tunnel jitter
+    # dominates a single trial, and the fastest trial is the honest
+    # steady-state device throughput.
     n_steps = 100
-    t0 = time.perf_counter()
-    for i in range(n_steps):
-        state, m = step(state, trainer.put_batch(batches[i % 8]))
-    jax.block_until_ready(m["loss"])
-    dt = time.perf_counter() - t0
+    n_trials = 5
+    dt = float("inf")
+    for _ in range(n_trials):
+        t0 = time.perf_counter()
+        for i in range(n_steps):
+            state, m = step(state, trainer.put_batch(batches[i % 8]))
+        jax.block_until_ready(m["loss"])
+        dt = min(dt, time.perf_counter() - t0)
 
     total_eps = n_steps * cfg.batch_size / dt
     per_chip = total_eps / max(n_dev, 1)

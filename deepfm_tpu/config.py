@@ -90,6 +90,7 @@ class Config:
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"   # MXU-friendly activations/matmuls
     remat: bool = False               # jax.checkpoint the DNN tower
+    use_pallas: bool = True           # fused Pallas FM kernel when on TPU
 
     # ---- checkpoint / export / logging ----
     model_dir: str = ""               # checkpoint dir (shared storage; reference :434)
@@ -103,6 +104,7 @@ class Config:
     auc_num_thresholds: int = 200     # parity with tf.metrics.auc default
     seed: int = 42
     profile_dir: str = ""             # jax.profiler trace output ('' = disabled)
+    profile_steps: int = 20           # steps traced per run (bounded window)
 
     # ------------------------------------------------------------------
     def __post_init__(self) -> None:

@@ -22,6 +22,7 @@ from typing import BinaryIO, Iterator, List, Optional, Union
 import numpy as np
 
 _CRC_TABLE = None
+_CRC_TABLES8 = None
 
 
 def _crc32c_table() -> np.ndarray:
@@ -38,14 +39,38 @@ def _crc32c_table() -> np.ndarray:
     return _CRC_TABLE
 
 
+def _crc32c_tables8():
+    """Slice-by-8 tables (plain int lists — faster than np scalars here)."""
+    global _CRC_TABLES8
+    if _CRC_TABLES8 is None:
+        t0 = [int(x) for x in _crc32c_table()]
+        tables = [t0]
+        for _ in range(7):
+            prev = tables[-1]
+            tables.append([t0[v & 0xFF] ^ (v >> 8) for v in prev])
+        _CRC_TABLES8 = tables
+    return _CRC_TABLES8
+
+
 def crc32c(data: bytes) -> int:
-    table = _crc32c_table()
-    crc = np.uint32(0xFFFFFFFF)
-    tab = table
-    # Vectorized-ish loop: process in python but with table lookups only.
-    c = int(crc)
-    for b in data:
-        c = (c >> 8) ^ int(tab[(c ^ b) & 0xFF])
+    """Pure-Python CRC32C, slice-by-8: one Python iteration per 8 bytes.
+
+    Still ~20x slower than the native library, but fast enough that the
+    no-toolchain fallback can keep CRC verification on (the pipeline
+    guarantees the same integrity check on both decode paths)."""
+    t = _crc32c_tables8()
+    t0, t1, t2, t3, t4, t5, t6, t7 = t
+    c = 0xFFFFFFFF
+    n8 = len(data) >> 3
+    if n8:
+        for (w,) in struct.iter_unpack("<Q", memoryview(data)[:n8 * 8]):
+            w ^= c
+            c = (t7[w & 0xFF] ^ t6[(w >> 8) & 0xFF]
+                 ^ t5[(w >> 16) & 0xFF] ^ t4[(w >> 24) & 0xFF]
+                 ^ t3[(w >> 32) & 0xFF] ^ t2[(w >> 40) & 0xFF]
+                 ^ t1[(w >> 48) & 0xFF] ^ t0[(w >> 56) & 0xFF])
+    for b in memoryview(data)[n8 * 8:]:
+        c = (c >> 8) ^ t0[(c ^ b) & 0xFF]
     return c ^ 0xFFFFFFFF
 
 

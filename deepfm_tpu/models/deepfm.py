@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from ..config import Config
 from ..ops import embedding as emb_ops
 from ..ops import fm as fm_ops
+from ..ops import pallas_fm
 from . import common
 
 
@@ -68,12 +69,17 @@ class DeepFM:
 
         # First-order: sum_f W[ids]*vals   (reference :177-179)
         w = emb_ops.lookup(params["fm_w"], feat_ids, axis_name=shard_axis)  # [B,F]
-        y_w = jnp.sum(w * feat_vals, axis=1)
-
         # Second-order FM over xv = V[ids]*vals   (reference :181-187)
         v = emb_ops.lookup(params["fm_v"], feat_ids, axis_name=shard_axis)  # [B,F,K]
         xv = v * feat_vals[..., None]
-        y_v = fm_ops.fm_interaction(xv)
+        if cfg.use_pallas and pallas_fm.supported(cfg.field_size,
+                                                 cfg.embedding_size):
+            # Fused Pallas path: both FM reductions in one VMEM pass over the
+            # same xv the tower consumes; d(xv)->d(v),d(vals) via JAX's
+            # product rule outside the kernel.
+            y_wv = pallas_fm.fused_fm(w, feat_vals, xv)
+        else:
+            y_wv = jnp.sum(w * feat_vals, axis=1) + fm_ops.fm_interaction(xv)
 
         # Deep tower over flattened xv   (reference :203-226)
         deep_in = xv.reshape(xv.shape[0], cfg.field_size * cfg.embedding_size)
@@ -86,7 +92,7 @@ class DeepFM:
         else:
             y_d, new_state = tower_fn(params["tower"], deep_in)
 
-        logits = params["fm_b"][0] + y_w + y_v + y_d  # [B] (reference :229-231)
+        logits = params["fm_b"][0] + y_wv + y_d  # [B] (reference :229-231)
         return logits, new_state
 
     # -- regularization -------------------------------------------------

@@ -60,13 +60,22 @@ def _load() -> Optional[ctypes.CDLL]:
         except OSError:
             _build_failed = True
             return None
+        # Buffer params are raw pointers (not c_char_p) so zero-copy views of
+        # bytes AND mmap objects both work via np.frombuffer.
         lib.dfm_split_frames.restype = ctypes.c_long
         lib.dfm_split_frames.argtypes = [
-            ctypes.c_char_p, ctypes.c_long, ctypes.c_long, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_ubyte), ctypes.c_long, ctypes.c_long,
+            ctypes.c_long,
             ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_long)]
+        lib.dfm_split_frames_ex.restype = ctypes.c_long
+        lib.dfm_split_frames_ex.argtypes = [
+            ctypes.POINTER(ctypes.c_ubyte), ctypes.c_long, ctypes.c_long,
+            ctypes.c_long, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_long)]
         lib.dfm_decode_ctr.restype = ctypes.c_long
         lib.dfm_decode_ctr.argtypes = [
-            ctypes.c_char_p, ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_ubyte), ctypes.POINTER(ctypes.c_long),
             ctypes.POINTER(ctypes.c_long), ctypes.c_long, ctypes.c_long,
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_float)]
@@ -86,7 +95,40 @@ def crc32c(data: bytes) -> int:
     return int(lib.dfm_crc32c(data, len(data)))
 
 
-def split_frames(buf: bytes, *, verify_crc: bool = True) -> Tuple[np.ndarray, np.ndarray]:
+def split_frames_partial(buf, *, verify_crc: bool = True
+                         ) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Like split_frames but tolerates an incomplete trailing record.
+
+    Returns (offsets, lengths, consumed): ``consumed`` is the byte count of
+    fully-framed records; the caller carries ``buf[consumed:]`` into the next
+    chunk. This is the chunked-streaming primitive — constant memory on
+    multi-GB shards, ordinary read() I/O (no mmap SIGBUS hazard on network
+    filesystems)."""
+    lib = _load()
+    assert lib is not None
+    cap = max(len(buf) // 16, 1)
+    offsets = np.empty(cap, dtype=np.int64)
+    lengths = np.empty(cap, dtype=np.int64)
+    consumed = ctypes.c_long(0)
+    n = lib.dfm_split_frames_ex(
+        _as_ubyte_ptr(buf), len(buf), int(verify_crc), 1, cap,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        ctypes.byref(consumed))
+    if n == -2:
+        raise IOError("corrupt TFRecord: CRC mismatch")
+    if n < 0:
+        raise IOError(f"TFRecord split error {n}")
+    return offsets[:n], lengths[:n], int(consumed.value)
+
+
+def _as_ubyte_ptr(buf) -> "ctypes.POINTER(ctypes.c_ubyte)":
+    """Zero-copy pointer to a bytes-like object (bytes, mmap, memoryview)."""
+    arr = np.frombuffer(buf, dtype=np.uint8)
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte))
+
+
+def split_frames(buf, *, verify_crc: bool = True) -> Tuple[np.ndarray, np.ndarray]:
     """Frame offsets/lengths of every record in a TFRecord byte buffer."""
     lib = _load()
     assert lib is not None
@@ -95,7 +137,7 @@ def split_frames(buf: bytes, *, verify_crc: bool = True) -> Tuple[np.ndarray, np
     offsets = np.empty(cap, dtype=np.int64)
     lengths = np.empty(cap, dtype=np.int64)
     n = lib.dfm_split_frames(
-        buf, len(buf), int(verify_crc), cap,
+        _as_ubyte_ptr(buf), len(buf), int(verify_crc), cap,
         offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
         lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_long)))
     if n == -1:
@@ -107,7 +149,7 @@ def split_frames(buf: bytes, *, verify_crc: bool = True) -> Tuple[np.ndarray, np
     return offsets[:n], lengths[:n]
 
 
-def _decode_spans(buf: bytes, offsets: np.ndarray, lengths: np.ndarray,
+def _decode_spans(buf, offsets: np.ndarray, lengths: np.ndarray,
                   field_size: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     lib = _load()
     assert lib is not None
@@ -118,7 +160,8 @@ def _decode_spans(buf: bytes, offsets: np.ndarray, lengths: np.ndarray,
     offsets = np.ascontiguousarray(offsets, dtype=np.int64)
     lengths = np.ascontiguousarray(lengths, dtype=np.int64)
     rc = lib.dfm_decode_ctr(
-        buf, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        _as_ubyte_ptr(buf),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
         lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
         n, field_size,
         labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),

@@ -83,14 +83,8 @@ inline bool read_varint(const uint8_t*& p, const uint8_t* end, uint64_t* out) {
     }
     shift += 7;
   }
-  // allow 10th byte for 64-bit two's complement values
-  if (p < end && shift == 70 - 7) {
-    uint8_t b = *p++;
-    if (!(b & 0x80)) {
-      *out = result | (static_cast<uint64_t>(b & 0x7F) << 63);
-      return true;
-    }
-  }
+  // Loop exits after consuming the 10th byte (shift 0..63 inclusive = 10
+  // iterations, covering 64-bit two's-complement varints) or on overrun.
   return false;
 }
 
@@ -257,15 +251,22 @@ extern "C" {
 
 // Split TFRecord frames in buf[0..len). Fills offsets/lengths (payload only,
 // excluding framing) up to max_records. verify_crc: 0 none, 1 both CRCs.
+// allow_partial: 1 = stop cleanly at an incomplete trailing record (chunked
+// streaming; *consumed tells the caller how many bytes were fully framed so
+// it can carry the tail into the next chunk). 0 = truncation is an error.
 // Returns record count, or negative: -1 truncated, -2 crc mismatch,
-// -3 capacity exceeded.
-long dfm_split_frames(const uint8_t* buf, long len, long verify_crc,
-                      long max_records, long* offsets, long* lengths) {
+// -3 capacity exceeded (only when allow_partial=0).
+long dfm_split_frames_ex(const uint8_t* buf, long len, long verify_crc,
+                         long allow_partial, long max_records,
+                         long* offsets, long* lengths, long* consumed) {
   init_crc_tables();
   long n = 0;
   long pos = 0;
   while (pos < len) {
-    if (len - pos < 12) return -1;
+    if (len - pos < 12) {
+      if (allow_partial) break;
+      return -1;
+    }
     uint64_t rec_len;
     std::memcpy(&rec_len, buf + pos, 8);
     if (verify_crc) {
@@ -273,19 +274,36 @@ long dfm_split_frames(const uint8_t* buf, long len, long verify_crc,
       std::memcpy(&stored, buf + pos + 8, 4);
       if (masked_crc32c(buf + pos, 8) != stored) return -2;
     }
-    if (static_cast<uint64_t>(len - pos - 12) < rec_len + 4) return -1;
+    // avail/rec_len compared without addition: rec_len + 4 could wrap uint64
+    // on a corrupt length field and defeat the bounds check.
+    uint64_t avail = static_cast<uint64_t>(len - pos - 12);
+    if (avail < 4 || rec_len > avail - 4) {
+      if (allow_partial) break;  // record continues past this chunk
+      return -1;
+    }
     if (verify_crc) {
       uint32_t stored;
       std::memcpy(&stored, buf + pos + 12 + rec_len, 4);
       if (masked_crc32c(buf + pos + 12, rec_len) != stored) return -2;
     }
-    if (n >= max_records) return -3;
+    if (n >= max_records) {
+      if (allow_partial) break;
+      return -3;
+    }
     offsets[n] = pos + 12;
     lengths[n] = static_cast<long>(rec_len);
     ++n;
     pos += 12 + rec_len + 4;
   }
+  if (consumed) *consumed = pos;
   return n;
+}
+
+// Back-compat whole-buffer splitter (strict framing).
+long dfm_split_frames(const uint8_t* buf, long len, long verify_crc,
+                      long max_records, long* offsets, long* lengths) {
+  return dfm_split_frames_ex(buf, len, verify_crc, /*allow_partial=*/0,
+                             max_records, offsets, lengths, nullptr);
 }
 
 // Decode n CTR Examples addressed by (offsets, lengths) into fixed-shape

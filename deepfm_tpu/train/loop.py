@@ -33,6 +33,7 @@ from ..config import Config
 from ..models import get_model
 from ..parallel import mesh as mesh_lib
 from ..utils import logging as ulog
+from ..utils import profiling as prof_lib
 from . import metrics as metrics_lib
 from . import optimizers as opt_lib
 from .state import TrainState
@@ -280,12 +281,15 @@ class Trainer:
         t0 = time.time()
         examples_since_log = 0
         n_steps = 0
+        meter = prof_lib.ThroughputMeter()
         for batch in batches:
             dev_batch = self.put_batch(batch)
             state, m = step_fn(state, dev_batch)
             n_steps += 1
-            examples_since_log += batch["label"].shape[0] * (
+            global_examples = batch["label"].shape[0] * (
                 jax.process_count() if self.mesh_info.mesh is not None else 1)
+            examples_since_log += global_examples
+            meter.update(global_examples)
             step_now = n_steps
             if cfg.log_steps and step_now % cfg.log_steps == 0:
                 loss = float(m["loss"])
@@ -303,7 +307,9 @@ class Trainer:
                 break
         if np.isnan(last_loss) and n_steps:
             last_loss = float(m["loss"])
-        return state, {"loss": last_loss, "steps": float(n_steps)}
+        out = {"loss": last_loss, "steps": float(n_steps)}
+        out.update({k: v for k, v in meter.summary().items() if k != "steps"})
+        return state, out
 
     def evaluate(
         self,

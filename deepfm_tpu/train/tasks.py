@@ -33,6 +33,7 @@ from ..parallel import bootstrap
 from ..utils import checkpoint as ckpt_lib
 from ..utils import export as export_lib
 from ..utils import logging as ulog
+from ..utils import profiling as prof_lib
 from .loop import Trainer
 from .state import TrainState
 
@@ -150,19 +151,26 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                     mgr.save(step, s)
             hooks.append(ckpt_hook)
 
-        for epoch in range(cfg.num_epochs):
-            # Per-epoch loop in the driver, per the reference's file-mode
-            # shape (dataset.repeat lives in streaming mode instead).
-            pipeline = make_pipeline(cfg, tr_files, epochs=1, shuffle=True)
-            state, fit_m = trainer.fit(state, pipeline, hooks=hooks)
-            result["loss"] = fit_m["loss"]
-            if va_files:
-                ev = trainer.evaluate(
-                    state, make_pipeline(cfg, va_files, shuffle=False))
-                ulog.info(
-                    f"epoch {epoch + 1}/{cfg.num_epochs}: eval auc="
-                    f"{ev['auc']:.5f} loss={ev['loss']:.5f}")
-                result.update({"auc": ev["auc"], "eval_loss": ev["loss"]})
+        tracer = prof_lib.StepWindowTracer(
+            cfg.profile_dir, num_steps=cfg.profile_steps)
+        hooks.append(lambda s, m: tracer.on_step())
+        try:
+            for epoch in range(cfg.num_epochs):
+                # Per-epoch loop in the driver, per the reference's file-mode
+                # shape (dataset.repeat lives in streaming mode instead).
+                pipeline = make_pipeline(cfg, tr_files, epochs=1, shuffle=True)
+                state, fit_m = trainer.fit(state, pipeline, hooks=hooks)
+                result["loss"] = fit_m["loss"]
+                result["examples_per_sec"] = fit_m.get("examples_per_sec", 0.0)
+                if va_files:
+                    ev = trainer.evaluate(
+                        state, make_pipeline(cfg, va_files, shuffle=False))
+                    ulog.info(
+                        f"epoch {epoch + 1}/{cfg.num_epochs}: eval auc="
+                        f"{ev['auc']:.5f} loss={ev['loss']:.5f}")
+                    result.update({"auc": ev["auc"], "eval_loss": ev["loss"]})
+        finally:
+            tracer.close()
         if mgr is not None:
             mgr.save(int(state.step), state, force=True)
     finally:

@@ -1,0 +1,122 @@
+"""Profiling / tracing subsystem (SURVEY.md §5 "tracing" equivalent).
+
+The reference ships with profiling *disabled* (``debugger_hook_config=False,
+disable_profiler=True``, ``deepfm-sagemaker-ps-cpu.ipynb:117-118``) and tunes
+via MKL/OMP env + thread pools instead. The TPU-native replacement is real
+tracing: ``jax.profiler`` XPlane traces viewable in TensorBoard/Perfetto,
+plus a lightweight step-time/throughput meter for always-on observability.
+
+Usage:
+    with maybe_trace(cfg.profile_dir):
+        ... training steps ...
+
+    meter = ThroughputMeter()
+    meter.update(n_examples)      # per step
+    meter.summary()               # {examples_per_sec, mean/p50/p99 step ms}
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator, List, Optional
+
+
+@contextlib.contextmanager
+def maybe_trace(profile_dir: Optional[str]) -> Iterator[None]:
+    """jax.profiler trace when ``profile_dir`` is set; no-op otherwise."""
+    if not profile_dir:
+        yield
+        return
+    import jax
+    with jax.profiler.trace(profile_dir):
+        yield
+
+
+class StepWindowTracer:
+    """Trace a bounded window of train steps.
+
+    Tracing every step of a long run buffers an unloadably large XPlane
+    file; the useful signal is a few steady-state steps. Starts after
+    ``start_step`` (skipping compile) and stops after ``num_steps`` traced
+    steps. ``on_step()`` is a fit-loop hook; ``close()`` stops an open
+    trace (e.g. when the run ends inside the window). No-op when
+    ``profile_dir`` is falsy.
+    """
+
+    def __init__(self, profile_dir: Optional[str], *, start_step: int = 2,
+                 num_steps: int = 20):
+        self.profile_dir = profile_dir
+        self.start_step = start_step
+        self.num_steps = num_steps
+        self._seen = 0
+        self._active = False
+        self._done = False
+
+    def on_step(self) -> None:
+        if not self.profile_dir or self._done:
+            return
+        import jax
+        self._seen += 1
+        if not self._active and self._seen >= self.start_step:
+            jax.profiler.start_trace(self.profile_dir)
+            self._active = True
+        elif self._active and self._seen >= self.start_step + self.num_steps:
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+
+    def close(self) -> None:
+        if self._active:
+            import jax
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named region in the profiler timeline (TraceAnnotation)."""
+    import jax
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+class ThroughputMeter:
+    """Step-time and examples/sec accumulator (host wall-clock).
+
+    Per-step wall time includes host input handoff — by design: with JAX
+    async dispatch the device step overlaps the next host batch, so the
+    steady-state wall time *is* the pipeline-limited step time.
+    """
+
+    def __init__(self, warmup_steps: int = 2):
+        self.warmup_steps = warmup_steps
+        self._step_times: List[float] = []
+        self._examples = 0
+        self._n_steps = 0
+        self._last = time.perf_counter()
+
+    def update(self, n_examples: int) -> None:
+        now = time.perf_counter()
+        self._n_steps += 1
+        if self._n_steps > self.warmup_steps:  # skip compile steps
+            self._step_times.append(now - self._last)
+            self._examples += n_examples
+        self._last = now
+
+    def summary(self) -> Dict[str, float]:
+        if not self._step_times:
+            return {"steps": float(self._n_steps)}
+        ts = sorted(self._step_times)
+        total = sum(ts)
+        n = len(ts)
+        return {
+            "steps": float(self._n_steps),
+            "examples_per_sec": self._examples / max(total, 1e-9),
+            "step_ms_mean": 1000.0 * total / n,
+            "step_ms_p50": 1000.0 * ts[n // 2],
+            # nearest-rank p99: ceil(0.99n)-1, not int(0.99n) (which would
+            # report the max for any n <= 100)
+            "step_ms_p99": 1000.0 * ts[max(0, -(-99 * n // 100) - 1)],
+        }

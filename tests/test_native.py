@@ -108,3 +108,36 @@ def test_native_is_faster(sample_file):
     pipeline.decode_batch_python(records, 7)
     t_python = time.perf_counter() - t0
     assert t_native < t_python, (t_native, t_python)
+
+
+def test_split_frames_partial_chunk_boundaries(sample_file):
+    """Partial splitter: cutting the buffer anywhere yields a clean carry."""
+    buf = open(sample_file, "rb").read()
+    full_off, full_len = loader.split_frames(buf)
+    for cut in (0, 5, 13, 100, len(buf) // 2, len(buf) - 3, len(buf)):
+        o1, l1, consumed = loader.split_frames_partial(buf[:cut])
+        assert consumed <= cut
+        # records found so far are a prefix of the full framing
+        assert list(o1) == [o for o in full_off if o - 12 < consumed]
+        # resume from the carry: remainder must frame to the rest
+        rest = buf[consumed:]
+        o2, l2, consumed2 = loader.split_frames_partial(rest)
+        assert consumed + consumed2 == len(buf)
+        assert len(o1) + len(o2) == len(full_off)
+
+
+def test_chunked_pipeline_reader_matches(sample_file, monkeypatch):
+    """The chunked native reader yields identical records at tiny chunk sizes
+    (forcing many carry-over boundaries)."""
+    want = tfrecord.read_all_records(sample_file)
+    monkeypatch.setattr(pipeline, "_NATIVE_CHUNK_BYTES", 97)
+    got = list(pipeline._iter_file_records(sample_file, use_native=True))
+    assert got == want
+
+
+def test_chunked_reader_truncated_file_errors(sample_file, tmp_path):
+    buf = open(sample_file, "rb").read()
+    bad = tmp_path / "trunc.tfrecords"
+    bad.write_bytes(buf[:-7])  # cut inside the final record
+    with pytest.raises(IOError):
+        list(pipeline._iter_file_records(str(bad), use_native=True))
