@@ -60,39 +60,52 @@ def _get_decoder(use_native: bool):
 _NATIVE_CHUNK_BYTES = 64 << 20
 
 
+def _native_loader():
+    """The native decoder module, or None when toolchain/build unavailable."""
+    try:
+        from ..native import loader  # noqa: PLC0415
+        if loader.available():
+            return loader
+    except ImportError:
+        pass
+    return None
+
+
+def _iter_framed_chunks(path: str, loader
+                        ) -> Iterator[Tuple[bytes, np.ndarray, np.ndarray]]:
+    """Chunked read() + C-speed framing with a carried partial tail: yields
+    (buf, offsets, lengths) per chunk. Constant memory on multi-GB shards,
+    and plain file I/O errors stay catchable Python exceptions (an mmap
+    would turn them into SIGBUS). The single framing state machine shared by
+    the record iterator and the vectorized decode path."""
+    with open(path, "rb") as f:
+        carry = b""
+        while True:
+            chunk = f.read(_NATIVE_CHUNK_BYTES)
+            if not chunk:
+                if carry:
+                    # Strict parse of the leftover: surfaces truncated-file
+                    # as an error, not silence.
+                    offsets, lengths = loader.split_frames(
+                        carry, verify_crc=True)
+                    yield carry, offsets, lengths
+                return
+            buf = carry + chunk if carry else chunk
+            offsets, lengths, consumed = loader.split_frames_partial(
+                buf, verify_crc=True)
+            yield buf, offsets, lengths
+            carry = buf[consumed:]
+
+
 def _iter_file_records(path: str, use_native: bool) -> Iterator[bytes]:
     """Per-file record iterator with CRC verified on both paths (same
-    integrity guarantee regardless of toolchain). Native path: chunked
-    read() + C-speed framing with a carried partial-tail — constant memory
-    on multi-GB shards, and plain file I/O errors stay catchable Python
-    exceptions (an mmap would turn them into SIGBUS)."""
-    if use_native:
-        try:
-            from ..native import loader  # noqa: PLC0415
-            if loader.available():
-                with open(path, "rb") as f:
-                    carry = b""
-                    while True:
-                        chunk = f.read(_NATIVE_CHUNK_BYTES)
-                        if not chunk:
-                            if carry:
-                                # Strict parse of the leftover: surfaces
-                                # truncated-file as an error, not silence.
-                                offsets, lengths = loader.split_frames(
-                                    carry, verify_crc=True)
-                                for off, ln in zip(offsets.tolist(),
-                                                   lengths.tolist()):
-                                    yield carry[off:off + ln]
-                            return
-                        buf = carry + chunk if carry else chunk
-                        offsets, lengths, consumed = loader.split_frames_partial(
-                            buf, verify_crc=True)
-                        for off, ln in zip(offsets.tolist(), lengths.tolist()):
-                            yield buf[off:off + ln]
-                        carry = buf[consumed:]
-                return
-        except ImportError:
-            pass
+    integrity guarantee regardless of toolchain)."""
+    loader = _native_loader() if use_native else None
+    if loader is not None:
+        for buf, offsets, lengths in _iter_framed_chunks(path, loader):
+            for off, ln in zip(offsets.tolist(), lengths.tolist()):
+                yield buf[off:off + ln]
+        return
     yield from tfrecord.iter_records(path, verify_crc=True)
 
 
@@ -132,6 +145,99 @@ class CtrPipeline:
         self.prefetch_batches = prefetch_batches
         self._use_native = use_native_decoder
         self._decode = _get_decoder(use_native_decoder)
+
+    # ------------------------------------------------------------------
+    # Vectorized fast path (native decode straight to arrays).
+    # ------------------------------------------------------------------
+    def _iter_decoded_chunks(self, epoch: int, loader
+                             ) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        """Per ~64MB chunk: native frame+decode -> (labels, ids, vals) arrays,
+        record-shard applied, rows permuted. No per-record Python anywhere."""
+        files = list(self._files)
+        if self.shuffle_files:
+            np.random.default_rng(self.seed + epoch).shuffle(files)
+        n_seen = 0
+        got_any = False
+        for path in files:
+            for buf, offsets, lengths in _iter_framed_chunks(path, loader):
+                if len(offsets) == 0:
+                    continue
+                got_any = True
+                labels, ids, vals = loader.decode_spans(
+                    buf, offsets, lengths, self.field_size)
+                n = len(labels)
+                if self._record_shard is not None:
+                    world, rank = self._record_shard
+                    keep = (np.arange(n_seen, n_seen + n) % world) == rank
+                    labels, ids, vals = labels[keep], ids[keep], vals[keep]
+                n_seen += n
+                if len(labels):
+                    yield labels, ids, vals
+        if not got_any and files:
+            raise IOError(f"no records found in {len(files)} files")
+
+    def _iter_batches_vectorized(self, loader) -> Iterator[Batch]:
+        """Pool decoded chunks to >= max(shuffle_buffer, chunk) rows, permute
+        the pool, then slice batches — at least the record path's shuffle
+        quality (the pool is the whole epoch on small data, a >= 64MB window
+        on large), with zero per-record Python."""
+        bs = self.batch_size
+        for epoch in range(self.num_epochs):
+            rng = np.random.default_rng(self.seed * 1_000_003 + epoch)
+            pool_target = max(self.shuffle_buffer, bs) if self.shuffle else bs
+            pend: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+            n_pend = 0
+
+            def drain(final: bool) -> Iterator[Batch]:
+                nonlocal pend, n_pend
+                if self.shuffle and len(pend) > 0:
+                    labels = np.concatenate([t[0] for t in pend])
+                    ids = np.concatenate([t[1] for t in pend])
+                    vals = np.concatenate([t[2] for t in pend])
+                    perm = rng.permutation(len(labels))
+                    pend = [(labels[perm], ids[perm], vals[perm])]
+                emit = n_pend if final else (n_pend // bs) * bs
+                while emit >= bs:
+                    yield self._assemble_batch(pend, bs)
+                    emit -= bs
+                    n_pend -= bs
+                if final and n_pend and not self.drop_remainder:
+                    yield self._assemble_batch(pend, n_pend)
+                    n_pend = 0
+
+            for chunk in self._iter_decoded_chunks(epoch, loader):
+                pend.append(chunk)
+                n_pend += len(chunk[0])
+                if n_pend >= pool_target:
+                    yield from drain(final=False)
+            yield from drain(final=True)
+
+    @staticmethod
+    def _assemble_batch(pend: List[Tuple[np.ndarray, np.ndarray, np.ndarray]],
+                        bs: int) -> Batch:
+        """Pop exactly ``bs`` rows off the front of the pending chunk list."""
+        take: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+        need = bs
+        while need:
+            labels, ids, vals = pend[0]
+            if len(labels) <= need:
+                take.append(pend.pop(0))
+                need -= len(labels)
+            else:
+                take.append((labels[:need], ids[:need], vals[:need]))
+                pend[0] = (labels[need:], ids[need:], vals[need:])
+                need = 0
+        if len(take) == 1:
+            labels, ids, vals = take[0]
+        else:
+            labels = np.concatenate([t[0] for t in take])
+            ids = np.concatenate([t[1] for t in take])
+            vals = np.concatenate([t[2] for t in take])
+        return {
+            "feat_ids": np.ascontiguousarray(ids, np.int32),
+            "feat_vals": np.ascontiguousarray(vals, np.float32),
+            "label": labels.reshape(-1, 1).astype(np.float32),
+        }
 
     # ------------------------------------------------------------------
     def _iter_raw_records(self, epoch: int) -> Iterator[bytes]:
@@ -189,16 +295,68 @@ class CtrPipeline:
             "label": labels.reshape(-1, 1).astype(np.float32),
         }
 
+    def _batch_source(self) -> Iterator[Batch]:
+        """Vectorized native path when available (whole chunks decoded to
+        arrays, numpy-level shuffle — the reference's 'vectorized map'
+        insight taken to its conclusion); per-record Python path otherwise.
+        Shuffle note: the vectorized path permutes within ~64MB decode
+        chunks (typically >> the 10k-record buffer of the record path),
+        plus the per-epoch file-order shuffle."""
+        loader = _native_loader() if self._use_native else None
+        if loader is not None:
+            return self._iter_batches_vectorized(loader)
+        return self._iter_batches_sync()
+
     # ------------------------------------------------------------------
     def __iter__(self) -> Iterator[Batch]:
         if self.prefetch_batches <= 0:
-            yield from self._iter_batches_sync()
+            yield from self._batch_source()
             return
-        yield from _prefetch(self._iter_batches_sync(), self.prefetch_batches)
+        yield from _prefetch(self._batch_source(), self.prefetch_batches)
 
     def count_examples(self) -> int:
         """One full pass counting records (respecting the shard)."""
         return sum(1 for _ in self._iter_raw_records(epoch=0))
+
+
+class ChainedFileStream:
+    """Sequential read()-only view over a list of files, replayed N times.
+
+    The producer side of the Pipe-mode analog: SageMaker's FIFO replays the
+    channel once per epoch (``num_epochs`` lives with the producer, not the
+    consumer — the FIFO cannot be re-opened, ``2-hvd-gpu/...py:396``). The
+    consumer (``StreamingCtrPipeline``) sees one continuous byte stream.
+    """
+
+    def __init__(self, files: Sequence[str], *, num_epochs: int = 1):
+        if not files:
+            raise ValueError("ChainedFileStream needs at least one file")
+        self._files = [f for _ in range(num_epochs) for f in files]
+        self._idx = 0
+        self._fh: Optional[BinaryIO] = None
+
+    def read(self, n: int = -1) -> bytes:
+        if n is None or n < 0:
+            raise ValueError("ChainedFileStream only supports bounded reads")
+        out = bytearray()
+        while len(out) < n:
+            if self._fh is None:
+                if self._idx >= len(self._files):
+                    break
+                self._fh = open(self._files[self._idx], "rb")
+                self._idx += 1
+            chunk = self._fh.read(n - len(out))
+            if not chunk:
+                self._fh.close()
+                self._fh = None
+                continue
+            out += chunk
+        return bytes(out)
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
 
 
 class StreamingCtrPipeline:
@@ -219,6 +377,7 @@ class StreamingCtrPipeline:
         drop_remainder: bool = True,
         prefetch_batches: int = 4,
         use_native_decoder: bool = True,
+        record_shard: Optional[Tuple[int, int]] = None,
     ):
         self.stream = stream
         self.field_size = field_size
@@ -226,7 +385,21 @@ class StreamingCtrPipeline:
         self.drop_remainder = drop_remainder
         self.prefetch_batches = prefetch_batches
         self._decode = _get_decoder(use_native_decoder)
+        self._record_shard = record_shard
         self._consumed = False
+
+    def _iter_records(self) -> Iterator[bytes]:
+        """Stream records, applying the (world, rank) record shard when this
+        process shares the stream with others (the dataset.shard analog for
+        Pipe mode — without it every rank would train the identical bytes)."""
+        it = tfrecord.iter_records_from_stream(self.stream)
+        if self._record_shard is None:
+            yield from it
+            return
+        world, rank = self._record_shard
+        for i, rec in enumerate(it):
+            if i % world == rank:
+                yield rec
 
     def _iter_sync(self) -> Iterator[Batch]:
         if self._consumed:
@@ -235,7 +408,7 @@ class StreamingCtrPipeline:
                 "create a new stream for another epoch")
         self._consumed = True
         pending: List[bytes] = []
-        for rec in tfrecord.iter_records_from_stream(self.stream):
+        for rec in self._iter_records():
             pending.append(rec)
             if len(pending) == self.batch_size:
                 labels, ids, vals = self._decode(pending, self.field_size)

@@ -13,12 +13,17 @@ import json
 import sys
 
 from .config import parse_args
+from .parallel import bootstrap
 from .train import tasks
 from .utils import logging as ulog
 
 
 def main(argv=None) -> int:
     cfg = parse_args(argv)
+    # Bootstrap before the first log line: rank-aware logging calls
+    # jax.process_index(), which would initialize the XLA backend and break
+    # a later jax.distributed.initialize() (it must run first).
+    bootstrap.initialize(cfg)
     ulog.info("config: " + json.dumps(cfg.to_dict(), sort_keys=True))
     result = tasks.run(cfg)
     ulog.info(f"task {cfg.task_type} finished: {result}")

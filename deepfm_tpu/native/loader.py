@@ -149,8 +149,8 @@ def split_frames(buf, *, verify_crc: bool = True) -> Tuple[np.ndarray, np.ndarra
     return offsets[:n], lengths[:n]
 
 
-def _decode_spans(buf, offsets: np.ndarray, lengths: np.ndarray,
-                  field_size: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+def decode_spans(buf, offsets: np.ndarray, lengths: np.ndarray,
+                 field_size: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     lib = _load()
     assert lib is not None
     n = len(offsets)
@@ -184,11 +184,11 @@ def decode_batch(records: Sequence[bytes], field_size: int
     offsets = np.zeros(len(records), dtype=np.int64)
     if len(records) > 1:
         np.cumsum(lengths[:-1], out=offsets[1:])
-    return _decode_spans(buf, offsets, lengths, field_size)
+    return decode_spans(buf, offsets, lengths, field_size)
 
 
 def decode_file_bytes(buf: bytes, field_size: int, *, verify_crc: bool = True
                       ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """One-pass decode of a whole TFRecord file buffer."""
     offsets, lengths = split_frames(buf, verify_crc=verify_crc)
-    return _decode_spans(buf, offsets, lengths, field_size)
+    return decode_spans(buf, offsets, lengths, field_size)

@@ -88,21 +88,63 @@ def make_pipeline(cfg: Config, files: List[str], *, epochs: int = 1,
     )
 
 
-def _restore_or_init(trainer: Trainer, cfg: Config,
-                     require: bool) -> TrainState:
+def make_streaming_pipeline(cfg: Config, files: List[str], *, epochs: int = 1
+                            ) -> pipe_lib.StreamingCtrPipeline:
+    """Pipe-mode analog (``--pipe_mode 1``): one sequential single-pass
+    stream over this process's file shard, epochs replayed producer-side
+    (the reference's FIFO shape, ``2-hvd-gpu/...py:403-405``). The shard's
+    record-level component carries through — when ranks share the same files
+    (fewer files than processes), each keeps every world-th record."""
+    shard = _shard_spec(cfg, files)
+    stream = pipe_lib.ChainedFileStream(list(shard.files), num_epochs=epochs)
+    return pipe_lib.StreamingCtrPipeline(
+        stream,
+        field_size=cfg.field_size,
+        batch_size=_local_batch_size(cfg),
+        drop_remainder=cfg.drop_remainder,
+        prefetch_batches=cfg.prefetch_batches,
+        use_native_decoder=cfg.use_native_decoder,
+        record_shard=shard.record_shard,
+    )
+
+
+def _restore_or_init(trainer: Trainer, cfg: Config, require: bool,
+                     mgr: Optional[ckpt_lib.CheckpointManager] = None
+                     ) -> TrainState:
+    """Init state, restoring from the latest checkpoint when one exists.
+
+    For require=True tasks (eval/infer/export) a missing/empty model_dir is
+    an error — checked by filesystem probe BEFORE any manager is built so a
+    mistyped path is not created as a side effect. (The probe outcome is
+    identical on all ranks: nothing creates the dir before this point.)
+    For train, the caller passes its manager in — manager construction runs
+    a cross-process barrier, so every rank must build the same managers in
+    the same order; an isdir-gated construction would race.
+    """
     state = trainer.init_state()
-    if cfg.model_dir and os.path.isdir(cfg.model_dir):
-        mgr = ckpt_lib.CheckpointManager(
-            cfg.model_dir, max_to_keep=cfg.keep_checkpoint_max)
-        try:
-            if mgr.latest_step() is not None:
-                state = mgr.restore(state)
-        finally:
-            mgr.close()
-    elif require:
+    if not cfg.model_dir:
+        if require:
+            raise FileNotFoundError(
+                f"task '{cfg.task_type}' requires model_dir")
+        return state
+    if require and not os.path.isdir(cfg.model_dir):
         raise FileNotFoundError(
             f"task '{cfg.task_type}' needs a checkpoint in model_dir="
             f"{cfg.model_dir!r}")
+    own = mgr is None
+    if own:
+        mgr = ckpt_lib.CheckpointManager(
+            cfg.model_dir, max_to_keep=cfg.keep_checkpoint_max)
+    try:
+        if mgr.latest_step() is not None:
+            state = mgr.restore(state)
+        elif require:
+            raise FileNotFoundError(
+                f"task '{cfg.task_type}' needs a checkpoint in model_dir="
+                f"{cfg.model_dir!r}")
+    finally:
+        if own:
+            mgr.close()
     return state
 
 
@@ -132,43 +174,73 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
     ulog.info(f"train files={len(tr_files)} eval files={len(va_files)}")
 
     if cfg.clear_existing_model and cfg.model_dir:
-        ckpt_lib.clear_model_dir(cfg.model_dir)
+        ckpt_lib.clear_model_dir(cfg.model_dir)  # chief-only rmtree
+        if jax.process_count() > 1:
+            # Barrier: no rank may construct its CheckpointManager (which
+            # re-creates the dir) until the chief's delete has completed.
+            from jax.experimental import multihost_utils  # noqa: PLC0415
+            multihost_utils.sync_global_devices("clear_model_dir")
 
-    state = _restore_or_init(trainer, cfg, require=False)
     mgr = None
     if cfg.model_dir:
         mgr = ckpt_lib.CheckpointManager(
             cfg.model_dir, max_to_keep=cfg.keep_checkpoint_max,
             save_interval_steps=cfg.save_checkpoints_steps)
+    state = _restore_or_init(trainer, cfg, require=False, mgr=mgr)
 
     result: Dict[str, float] = {}
     try:
         hooks = []
         if mgr is not None:
+            # Host-side step counter: reading s.step would force a device
+            # sync every step (it blocks on the async-dispatched update),
+            # collapsing throughput — one sync at restore time instead.
+            step_counter = [int(state.step)]
+
             def ckpt_hook(s: TrainState, m) -> None:
-                step = int(s.step)
-                if mgr.should_save(step):
-                    mgr.save(step, s)
+                step_counter[0] += 1
+                if mgr.should_save(step_counter[0]):
+                    mgr.save(step_counter[0], s)
             hooks.append(ckpt_hook)
 
         tracer = prof_lib.StepWindowTracer(
             cfg.profile_dir, num_steps=cfg.profile_steps)
         hooks.append(lambda s, m: tracer.on_step())
         try:
-            for epoch in range(cfg.num_epochs):
-                # Per-epoch loop in the driver, per the reference's file-mode
-                # shape (dataset.repeat lives in streaming mode instead).
-                pipeline = make_pipeline(cfg, tr_files, epochs=1, shuffle=True)
+            if cfg.pipe_mode:
+                # Streaming (Pipe-mode analog): ONE train call consuming a
+                # single-pass stream with all epochs replayed producer-side —
+                # the reference pipe-mode shape (``2-hvd-gpu/...py:403-405``,
+                # FIFO not reusable per epoch). Eval afterwards, file-mode.
+                pipeline = make_streaming_pipeline(
+                    cfg, tr_files, epochs=cfg.num_epochs)
                 state, fit_m = trainer.fit(state, pipeline, hooks=hooks)
                 result["loss"] = fit_m["loss"]
                 result["examples_per_sec"] = fit_m.get("examples_per_sec", 0.0)
                 if va_files:
                     ev = trainer.evaluate(
                         state, make_pipeline(cfg, va_files, shuffle=False))
-                    ulog.info(
-                        f"epoch {epoch + 1}/{cfg.num_epochs}: eval auc="
-                        f"{ev['auc']:.5f} loss={ev['loss']:.5f}")
+                    ulog.info(f"streaming train done: eval auc={ev['auc']:.5f} "
+                              f"loss={ev['loss']:.5f}")
                     result.update({"auc": ev["auc"], "eval_loss": ev["loss"]})
+            else:
+                for epoch in range(cfg.num_epochs):
+                    # Per-epoch loop in the driver, per the reference's
+                    # file-mode shape (``2-hvd-gpu/...py:390-394``).
+                    pipeline = make_pipeline(cfg, tr_files, epochs=1,
+                                             shuffle=True)
+                    state, fit_m = trainer.fit(state, pipeline, hooks=hooks)
+                    result["loss"] = fit_m["loss"]
+                    result["examples_per_sec"] = fit_m.get(
+                        "examples_per_sec", 0.0)
+                    if va_files:
+                        ev = trainer.evaluate(
+                            state, make_pipeline(cfg, va_files, shuffle=False))
+                        ulog.info(
+                            f"epoch {epoch + 1}/{cfg.num_epochs}: eval auc="
+                            f"{ev['auc']:.5f} loss={ev['loss']:.5f}")
+                        result.update({"auc": ev["auc"],
+                                       "eval_loss": ev["loss"]})
         finally:
             tracer.close()
         if mgr is not None:

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Measure the BASELINE.md table cells on the current accelerator.
+
+Generates a reference-shaped synthetic Criteo-like dataset (the reference
+trained on real Criteo; shape anchors from BASELINE.md — feature_size=117581,
+field_size=39, embedding_size=32, deep 128/64/32, batch 1024, Adam 5e-4) and
+runs the measurable configs end-to-end through the task driver, printing one
+JSON line per config:
+
+    {"config": ..., "examples_per_sec": ..., "auc": ..., "devices": N}
+
+Usage:  python scripts/measure_baseline.py [--quick] [--configs deepfm,widedeep,dcnv2]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+FEATURE_SIZE = 117581
+FIELD_SIZE = 39
+
+
+def ensure_data(root: str, n_train: int, n_eval: int) -> str:
+    from deepfm_tpu.data import libsvm
+    d = os.path.join(root, f"criteo_syn_{n_train}")
+    if not os.path.isdir(d):
+        n_files = 8
+        libsvm.generate_synthetic_ctr(
+            d, num_files=n_files, examples_per_file=n_train // n_files,
+            feature_size=FEATURE_SIZE, field_size=FIELD_SIZE, prefix="tr",
+            seed=1)
+        libsvm.generate_synthetic_ctr(
+            d, num_files=1, examples_per_file=n_eval,
+            feature_size=FEATURE_SIZE, field_size=FIELD_SIZE, prefix="va",
+            seed=2)
+    return d
+
+
+def run_config(name: str, model: str, data_dir: str, epochs: int) -> dict:
+    import jax
+    from deepfm_tpu.config import Config
+    from deepfm_tpu.train import tasks
+
+    with tempfile.TemporaryDirectory() as ckpt:
+        cfg = Config(
+            model=model,
+            feature_size=FEATURE_SIZE, field_size=FIELD_SIZE,
+            embedding_size=32, deep_layers="128,64,32",
+            dropout="0.5,0.5,0.5", batch_size=1024,
+            learning_rate=5e-4, optimizer="Adam", l2_reg=1e-4,
+            num_epochs=epochs, data_dir=data_dir, val_data_dir=data_dir,
+            model_dir=os.path.join(ckpt, "m"), log_steps=200,
+            save_checkpoints_steps=10 ** 9, compute_dtype="bfloat16",
+        )
+        result = tasks.run(cfg)
+    out = {
+        "config": name,
+        "model": model,
+        "examples_per_sec": round(result.get("examples_per_sec", 0.0), 1),
+        "auc": round(result.get("auc", 0.0), 5),
+        "eval_loss": round(result.get("eval_loss", 0.0), 5),
+        "steps": result.get("steps"),
+        "devices": len(jax.devices()),
+        "backend": jax.default_backend(),
+    }
+    print(json.dumps(out), flush=True)
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="small dataset / few epochs (smoke)")
+    ap.add_argument("--configs", default="deepfm,widedeep,dcnv2")
+    ap.add_argument("--epochs", type=int, default=0,
+                    help="override epoch count (default: 10 full, 2 quick)")
+    ap.add_argument("--data_root", default="/tmp/deepfm_tpu_bench")
+    args = ap.parse_args()
+
+    n_train, n_eval = (20_480, 10_240) if args.quick else (204_800, 51_200)
+    epochs = args.epochs or (2 if args.quick else 10)
+    data_dir = ensure_data(args.data_root, n_train, n_eval)
+
+    for model in args.configs.split(","):
+        run_config(f"{model}_criteo_shape", model, data_dir, epochs)
+
+
+if __name__ == "__main__":
+    main()

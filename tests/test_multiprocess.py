@@ -1,0 +1,101 @@
+"""True multi-process distributed test: 2 OS processes, jax.distributed
+rendezvous, a 4x2 ('data','model') mesh spanning both — DP gradient psum AND
+cross-process row-sharded embeddings, end-to-end through the CLI launcher.
+
+This is the "local cluster" validation the reference did by hand-building
+TF_CONFIG and launching ps/chief/worker processes (``set_dist_env``,
+``1-ps-cpu/...py:294-339``) — here it's automated (SURVEY.md §4).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from deepfm_tpu.data import libsvm
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_RUNNER = """
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import sys
+from deepfm_tpu.launch import main
+sys.exit(main(sys.argv[1:]))
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def mp_workdir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("mp")
+    libsvm.generate_synthetic_ctr(
+        str(d / "data"), num_files=4, examples_per_file=128,
+        feature_size=300, field_size=5, prefix="tr", seed=11)
+    libsvm.generate_synthetic_ctr(
+        str(d / "data"), num_files=1, examples_per_file=128,
+        feature_size=300, field_size=5, prefix="va", seed=12)
+    return d
+
+
+def test_two_process_train(mp_workdir):
+    port = _free_port()
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+        PYTHONPATH=_REPO,
+    )
+    args = [
+        "--task_type", "train",
+        "--dist_mode", "1",
+        "--num_processes", "2",
+        "--coordinator_address", f"localhost:{port}",
+        "--data_dir", str(mp_workdir / "data"),
+        "--val_data_dir", str(mp_workdir / "data"),
+        "--model_dir", str(mp_workdir / "ckpt"),
+        "--feature_size", "300", "--field_size", "5",
+        "--embedding_size", "8", "--deep_layers", "16,8",
+        "--dropout", "1.0,1.0", "--batch_size", "64",
+        "--num_epochs", "2", "--learning_rate", "0.05",
+        "--scale_lr_by_world", "false",
+        "--compute_dtype", "float32",
+        "--mesh_data", "4", "--mesh_model", "2",
+        "--log_steps", "0", "--save_checkpoints_steps", "5",
+        "--seed", "3",
+    ]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _RUNNER] + args + ["--process_id", str(r)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, cwd=_REPO)
+        for r in range(2)
+    ]
+    outs = []
+    for r, p in enumerate(procs):
+        out, err = p.communicate(timeout=420)
+        assert p.returncode == 0, f"rank {r} failed:\n{err[-3000:]}"
+        outs.append(out)
+
+    results = []
+    for out in outs:
+        line = [ln for ln in out.splitlines() if ln.startswith("{")][-1]
+        results.append(json.loads(line))
+
+    # Replicated-by-construction training: every rank reports the SAME
+    # loss/AUC (the broadcast-hook analog holds through real psum traffic).
+    assert results[0]["steps"] == 2 * (4 * 128 // 64)
+    assert results[0]["loss"] == pytest.approx(results[1]["loss"], abs=1e-6)
+    assert results[0]["auc"] == pytest.approx(results[1]["auc"], abs=1e-6)
+    assert results[0]["auc"] > 0.55, results[0]
+
+    # Chief-only checkpointing: rank 0 wrote it, rank 1 did not duplicate.
+    assert os.path.isdir(mp_workdir / "ckpt")
