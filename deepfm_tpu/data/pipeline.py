@@ -127,6 +127,7 @@ class CtrPipeline:
         shard: Optional[sharding.ShardSpec] = None,
         prefetch_batches: int = 4,
         use_native_decoder: bool = True,
+        reader_threads: int = 4,
     ):
         if shard is not None:
             self._files: Tuple[str, ...] = shard.files
@@ -143,6 +144,7 @@ class CtrPipeline:
         self.drop_remainder = drop_remainder
         self.seed = seed
         self.prefetch_batches = prefetch_batches
+        self.reader_threads = max(reader_threads, 1)
         self._use_native = use_native_decoder
         self._decode = _get_decoder(use_native_decoder)
 
@@ -158,21 +160,52 @@ class CtrPipeline:
             np.random.default_rng(self.seed + epoch).shuffle(files)
         n_seen = 0
         got_any = False
-        for path in files:
-            for buf, offsets, lengths in _iter_framed_chunks(path, loader):
-                if len(offsets) == 0:
-                    continue
-                got_any = True
-                labels, ids, vals = loader.decode_spans(
-                    buf, offsets, lengths, self.field_size)
-                n = len(labels)
-                if self._record_shard is not None:
-                    world, rank = self._record_shard
-                    keep = (np.arange(n_seen, n_seen + n) % world) == rank
-                    labels, ids, vals = labels[keep], ids[keep], vals[keep]
-                n_seen += n
-                if len(labels):
-                    yield labels, ids, vals
+
+        def jobs() -> Iterator[Tuple[bytes, np.ndarray, np.ndarray, int]]:
+            nonlocal n_seen, got_any
+            for path in files:
+                for buf, offsets, lengths in _iter_framed_chunks(path, loader):
+                    if len(offsets) == 0:
+                        continue
+                    got_any = True
+                    yield buf, offsets, lengths, n_seen
+                    n_seen += len(offsets)
+
+        def decode(job: Tuple[bytes, np.ndarray, np.ndarray, int]):
+            buf, offsets, lengths, base = job
+            labels, ids, vals = loader.decode_spans(
+                buf, offsets, lengths, self.field_size)
+            if self._record_shard is not None:
+                world, rank = self._record_shard
+                keep = (np.arange(base, base + len(labels)) % world) == rank
+                labels, ids, vals = labels[keep], ids[keep], vals[keep]
+            return labels, ids, vals
+
+        # Decode chunks on a thread pool (the C decoder releases the GIL, so
+        # this scales on real cores) while framing/IO stays on the producer.
+        # Bounded in-flight depth keeps memory ~threads x chunk; FIFO
+        # consumption preserves deterministic chunk order.
+        n_threads = self.reader_threads
+        if n_threads <= 1:
+            for job in jobs():
+                out = decode(job)
+                if len(out[0]):
+                    yield out
+        else:
+            import collections  # noqa: PLC0415
+            from concurrent.futures import ThreadPoolExecutor  # noqa: PLC0415
+            with ThreadPoolExecutor(n_threads) as ex:
+                inflight: "collections.deque" = collections.deque()
+                for job in jobs():
+                    inflight.append(ex.submit(decode, job))
+                    while len(inflight) >= n_threads + 1:
+                        out = inflight.popleft().result()
+                        if len(out[0]):
+                            yield out
+                while inflight:
+                    out = inflight.popleft().result()
+                    if len(out[0]):
+                        yield out
         if not got_any and files:
             raise IOError(f"no records found in {len(files)} files")
 

@@ -85,6 +85,7 @@ def make_pipeline(cfg: Config, files: List[str], *, epochs: int = 1,
         shard=_shard_spec(cfg, files) if sharded else None,
         prefetch_batches=cfg.prefetch_batches,
         use_native_decoder=cfg.use_native_decoder,
+        reader_threads=cfg.reader_threads,
     )
 
 
