@@ -4,25 +4,23 @@ This is the TPU-native analog of the reference's local-cluster escape hatch
 (`set_dist_env()`, 1-ps-cpu/...py:294-339): distributed semantics are tested
 on one machine by splitting the host CPU into 8 XLA devices.
 
-Note: the environment's sitecustomize eagerly registers the TPU backend, so
-the env var alone is not enough — jax.config must be updated post-import
-(before any CPU client exists) for the override to stick.
+The provisioning recipe (XLA_FLAGS + JAX_PLATFORMS + post-import
+jax.config.update — env vars alone are not enough because the environment's
+sitecustomize eagerly registers the TPU backend) lives in ONE place:
+``__graft_entry__._provision_virtual_devices``, shared with the driver's
+multichip dry run.
 """
 import os
 import sys
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"  # tests never target the real TPU
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+from __graft_entry__ import _provision_virtual_devices  # noqa: E402
+
+_provision_virtual_devices(8)
+
 import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def pytest_configure(config):
