@@ -83,6 +83,9 @@ class Config:
     prefetch_batches: int = 4
     reader_threads: int = 4           # host decode parallelism (MKL/OMP analog)
     use_native_decoder: bool = True   # C++ TFRecord decode path
+    verify_crc: bool = True           # CRC32C-check records (off: ~15% faster decode)
+    steps_per_loop: int = 8           # optimizer steps per host dispatch (lax.scan)
+    transfer_ahead: int = 2           # host->device staging depth (batches ahead)
 
     # ---- mesh / parallelism (replaces TF_CONFIG + horovod knobs) ----
     mesh_data: int = 0                # data-parallel axis size (0 = all devices)
@@ -125,6 +128,8 @@ class Config:
             raise ValueError("batch_size must be positive")
         if self.mesh_model < 1:
             raise ValueError("mesh_model must be >= 1")
+        if self.steps_per_loop < 1:
+            raise ValueError("steps_per_loop must be >= 1")
 
     # ---- derived views ------------------------------------------------
     @property

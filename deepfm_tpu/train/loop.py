@@ -49,6 +49,7 @@ class Trainer:
         self.tx = opt_lib.build_optimizer(cfg, world_size=self.mesh_info.data_size)
         self._specs: Optional[Dict[str, Any]] = None
         self._train_step: Optional[Callable] = None
+        self._multi_step: Optional[Callable] = None
         self._eval_step: Optional[Callable] = None
         self._predict_step: Optional[Callable] = None
 
@@ -115,44 +116,51 @@ class Trainer:
             xent = jnp.mean(jnp.square(jax.nn.sigmoid(logits) - labels))
         return logits, xent, new_mstate
 
+    def _step_impl(self, state: TrainState, batch, *, data_axis, shard_axis
+                   ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
+        """One optimizer step (raw, mesh-axis-aware; wrapped by jit/shard_map
+        in _make_train_step and scanned in _make_train_multi_step)."""
+        rng = jax.random.fold_in(state.rng, state.step)
+        if data_axis is not None:
+            # Distinct dropout per data shard; identical across model
+            # shards (keeps activations replicated over 'model').
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(data_axis))
+
+        def loss_fn(params):
+            _, xent, new_mstate = self._loss_terms(
+                params, state.model_state, batch, train=True, rng=rng,
+                shard_axis=shard_axis, data_axis=data_axis)
+            if data_axis is not None:
+                # THE gradient sync point: the loss is made a *global*
+                # scalar (mean over the data axis); differentiating it
+                # under shard_map's replication-aware AD yields gradients
+                # with the cross-replica psum already inserted by XLA —
+                # this replaces hvd.DistributedOptimizer's NCCL allreduce
+                # (2-hvd-gpu/...py:262) and the PS push/pull (X1).
+                xent = jax.lax.pmean(xent, data_axis)
+            l2 = self.model.l2_loss(params)
+            if shard_axis is not None:
+                # l2 over the full row-sharded table (invariant scalar).
+                l2 = jax.lax.psum(l2, shard_axis)
+            return xent + l2, (xent, l2, new_mstate)
+
+        (_, (xent, l2, new_mstate)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1, params=new_params, opt_state=new_opt,
+            model_state=new_mstate)
+        return new_state, {"loss": xent + l2, "xent": xent}
+
     def _make_train_step(self) -> Callable:
         mi = self.mesh_info
         shard_axis = mi.model_axis if mi.model_size > 1 else None
         data_axis = mi.data_axis
 
-        def step(state: TrainState, batch) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
-            rng = jax.random.fold_in(state.rng, state.step)
-            if data_axis is not None:
-                # Distinct dropout per data shard; identical across model
-                # shards (keeps activations replicated over 'model').
-                rng = jax.random.fold_in(rng, jax.lax.axis_index(data_axis))
-
-            def loss_fn(params):
-                _, xent, new_mstate = self._loss_terms(
-                    params, state.model_state, batch, train=True, rng=rng,
-                    shard_axis=shard_axis, data_axis=data_axis)
-                if data_axis is not None:
-                    # THE gradient sync point: the loss is made a *global*
-                    # scalar (mean over the data axis); differentiating it
-                    # under shard_map's replication-aware AD yields gradients
-                    # with the cross-replica psum already inserted by XLA —
-                    # this replaces hvd.DistributedOptimizer's NCCL allreduce
-                    # (2-hvd-gpu/...py:262) and the PS push/pull (X1).
-                    xent = jax.lax.pmean(xent, data_axis)
-                l2 = self.model.l2_loss(params)
-                if shard_axis is not None:
-                    # l2 over the full row-sharded table (invariant scalar).
-                    l2 = jax.lax.psum(l2, shard_axis)
-                return xent + l2, (xent, l2, new_mstate)
-
-            (_, (xent, l2, new_mstate)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(state.params)
-            updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
-            new_params = optax.apply_updates(state.params, updates)
-            new_state = state.replace(
-                step=state.step + 1, params=new_params, opt_state=new_opt,
-                model_state=new_mstate)
-            return new_state, {"loss": xent + l2, "xent": xent}
+        def step(state: TrainState, batch):
+            return self._step_impl(
+                state, batch, data_axis=data_axis, shard_axis=shard_axis)
 
         if mi.mesh is None:
             return jax.jit(step, donate_argnums=0)
@@ -164,6 +172,60 @@ class Trainer:
                 out_specs=(specs["state"], P()),
                 check_vma=True),
             donate_argnums=0)
+
+    def _make_train_multi_step(self) -> Callable:
+        """K optimizer steps in ONE dispatch: lax.scan over a stacked batch
+        [K, B, ...] (K comes from the batch's leading dim; jit specializes
+        per shape). Bit-identical to K sequential train_step calls (same rng
+        folding, same update order) but amortizes the per-step host dispatch
+        and host->device transfer overhead — the dominant e2e cost on a
+        single-core host (see README Performance)."""
+        mi = self.mesh_info
+        shard_axis = mi.model_axis if mi.model_size > 1 else None
+        data_axis = mi.data_axis
+
+        def multi(state: TrainState, batches):
+            def body(st, batch):
+                new_st, m = self._step_impl(
+                    st, batch, data_axis=data_axis, shard_axis=shard_axis)
+                return new_st, jnp.stack((m["loss"], m["xent"]))
+            state2, ms = jax.lax.scan(body, state, batches)
+            # Last-step metrics: matches what a sequential loop would report.
+            return state2, {"loss": ms[-1, 0], "xent": ms[-1, 1]}
+
+        # Donate only the state: scanned batch buffers are not reusable as
+        # outputs (XLA reports them unusable and warns).
+        if mi.mesh is None:
+            return jax.jit(multi, donate_argnums=0)
+        specs = self._dummy_specs()
+        sb_specs = jax.tree.map(lambda s: P(None, *s), specs["batch"])
+        return jax.jit(
+            shard_map(
+                multi, mesh=mi.mesh,
+                in_specs=(specs["state"], sb_specs),
+                out_specs=(specs["state"], P()),
+                check_vma=True),
+            donate_argnums=0)
+
+    @property
+    def multi_step(self) -> Callable:
+        if self._multi_step is None:
+            self._multi_step = self._make_train_multi_step()
+        return self._multi_step
+
+    def put_superbatch(self, batches) -> Dict[str, jax.Array]:
+        """Stack K host batches into [K, B, ...] arrays and transfer in one
+        host->device move (batch dim sharded over 'data', K replicated)."""
+        stacked = {
+            key: np.stack([b[key] for b in batches]) for key in batches[0]}
+        mi = self.mesh_info
+        if mi.mesh is None:
+            return jax.device_put(stacked)
+        return jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(
+                mi.sharding(
+                    P(None, mesh_lib.DATA_AXIS, *([None] * (x.ndim - 2)))), x),
+            stacked)
 
     def _make_eval_step(self) -> Callable:
         mi = self.mesh_info
@@ -266,6 +328,34 @@ class Trainer:
             self._predict_step = self._make_predict_step()
         return self._predict_step
 
+    def _stage(self, batches: Iterable[Dict[str, np.ndarray]], k: int,
+               depth: int):
+        """Group host batches into K-step superbatches and move them to device
+        on a background thread, ``depth`` dispatch-groups ahead — overlapping
+        the host->device transfer with step dispatch (the prefetch-to-device
+        iterator analog of X3). Yields (device_batches, n_steps, n_local_ex).
+        A tail group smaller than K is staged as single steps (no recompile
+        for odd sizes)."""
+
+        def gen():
+            group = []
+            for b in batches:
+                group.append(b)
+                if len(group) == k:
+                    n_ex = sum(g["label"].shape[0] for g in group)
+                    if k == 1:
+                        yield self.put_batch(group[0]), 1, n_ex
+                    else:
+                        yield self.put_superbatch(group), k, n_ex
+                    group = []
+            for b in group:
+                yield self.put_batch(b), 1, b["label"].shape[0]
+
+        if depth <= 0:
+            return gen()
+        from ..data.pipeline import _prefetch  # noqa: PLC0415
+        return _prefetch(gen(), depth)
+
     def fit(
         self,
         state: TrainState,
@@ -274,25 +364,37 @@ class Trainer:
         hooks: Optional[list] = None,
         max_steps: Optional[int] = None,
     ) -> Tuple[TrainState, Dict[str, float]]:
-        """Run the train loop over an iterable of host batches."""
+        """Run the train loop over an iterable of host batches.
+
+        Dispatches ``cfg.steps_per_loop`` optimizer steps per host round trip
+        (one stacked transfer + one lax.scan program); hooks fire once per
+        dispatch with ``metrics["steps_done"]`` = number of steps taken.
+        """
         cfg = self.cfg
-        step_fn = self.train_step
+        k = max(cfg.steps_per_loop, 1)
+        world = jax.process_count() if self.mesh_info.mesh is not None else 1
+        if max_steps is not None:
+            import itertools  # noqa: PLC0415
+            batches = itertools.islice(iter(batches), max_steps)
         last_loss = float("nan")
         t0 = time.time()
         examples_since_log = 0
         n_steps = 0
+        m: Dict[str, Any] = {}
         meter = prof_lib.ThroughputMeter()
-        for batch in batches:
-            dev_batch = self.put_batch(batch)
-            state, m = step_fn(state, dev_batch)
-            n_steps += 1
-            global_examples = batch["label"].shape[0] * (
-                jax.process_count() if self.mesh_info.mesh is not None else 1)
-            examples_since_log += global_examples
-            meter.update(global_examples)
-            step_now = n_steps
-            if cfg.log_steps and step_now % cfg.log_steps == 0:
-                loss = float(m["loss"])
+        for dev_batch, steps_done, local_ex in self._stage(
+                batches, k, cfg.transfer_ahead):
+            if steps_done == 1:
+                state, m = self.train_step(state, dev_batch)
+            else:
+                state, m = self.multi_step(state, dev_batch)
+            prev_steps = n_steps
+            n_steps += steps_done
+            examples_since_log += local_ex * world
+            meter.update(local_ex * world, steps_done)
+            if cfg.log_steps and (n_steps // cfg.log_steps
+                                  > prev_steps // cfg.log_steps):
+                loss = float(m["loss"])  # device sync, bounded by log cadence
                 last_loss = loss
                 dt = time.time() - t0
                 eps = examples_since_log / max(dt, 1e-9)
@@ -301,14 +403,21 @@ class Trainer:
                     f"examples/sec={eps:,.0f}")
                 t0 = time.time()
                 examples_since_log = 0
-            for hook in hooks or []:
-                hook(state, m)
-            if max_steps is not None and n_steps >= max_steps:
-                break
+            if hooks:
+                m = dict(m)
+                m["steps_done"] = steps_done
+                for hook in hooks:
+                    hook(state, m)
+        if n_steps:
+            # Fold the async-dispatch drain into the measurement window so
+            # the meter reports completed-on-device throughput, not host
+            # dispatch rate.
+            jax.block_until_ready(m["loss"])
+            meter.record_drain()
         if np.isnan(last_loss) and n_steps:
             last_loss = float(m["loss"])
         out = {"loss": last_loss, "steps": float(n_steps)}
-        out.update({k: v for k, v in meter.summary().items() if k != "steps"})
+        out.update({k_: v for k_, v in meter.summary().items() if k_ != "steps"})
         return state, out
 
     def evaluate(

@@ -86,6 +86,7 @@ def make_pipeline(cfg: Config, files: List[str], *, epochs: int = 1,
         prefetch_batches=cfg.prefetch_batches,
         use_native_decoder=cfg.use_native_decoder,
         reader_threads=cfg.reader_threads,
+        verify_crc=cfg.verify_crc,
     )
 
 
@@ -97,7 +98,9 @@ def make_streaming_pipeline(cfg: Config, files: List[str], *, epochs: int = 1
     record-level component carries through — when ranks share the same files
     (fewer files than processes), each keeps every world-th record."""
     shard = _shard_spec(cfg, files)
-    stream = pipe_lib.ChainedFileStream(list(shard.files), num_epochs=epochs)
+    stream = pipe_lib.ChainedFileStream(
+        list(shard.files), num_epochs=epochs,
+        shuffle_each_epoch=cfg.shuffle_files, seed=cfg.seed)
     return pipe_lib.StreamingCtrPipeline(
         stream,
         field_size=cfg.field_size,
@@ -106,6 +109,7 @@ def make_streaming_pipeline(cfg: Config, files: List[str], *, epochs: int = 1
         prefetch_batches=cfg.prefetch_batches,
         use_native_decoder=cfg.use_native_decoder,
         record_shard=shard.record_shard,
+        verify_crc=cfg.verify_crc,
     )
 
 
@@ -199,14 +203,14 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
             step_counter = [int(state.step)]
 
             def ckpt_hook(s: TrainState, m) -> None:
-                step_counter[0] += 1
+                step_counter[0] += int(m.get("steps_done", 1))
                 if mgr.should_save(step_counter[0]):
                     mgr.save(step_counter[0], s)
             hooks.append(ckpt_hook)
 
         tracer = prof_lib.StepWindowTracer(
             cfg.profile_dir, num_steps=cfg.profile_steps)
-        hooks.append(lambda s, m: tracer.on_step())
+        hooks.append(lambda s, m: tracer.on_step(int(m.get("steps_done", 1))))
         try:
             if cfg.pipe_mode:
                 # Streaming (Pipe-mode analog): ONE train call consuming a

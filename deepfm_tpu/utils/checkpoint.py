@@ -34,6 +34,7 @@ class CheckpointManager:
         )
         self._mgr = ocp.CheckpointManager(self._dir, options=options)
         self.save_interval_steps = save_interval_steps
+        self._last_should_save_step: Optional[int] = None
 
     @property
     def directory(self) -> str:
@@ -43,6 +44,8 @@ class CheckpointManager:
         return self._mgr.latest_step()
 
     def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+        if step in self._mgr.all_steps():
+            return False  # e.g. final forced save after an interval save hit it
         saved = self._mgr.save(step, args=ocp.args.StandardSave(state), force=force)
         if saved:
             ulog.info(f"checkpoint saved at step {step} -> {self._dir}")
@@ -60,7 +63,18 @@ class CheckpointManager:
         return restored
 
     def should_save(self, step: int) -> bool:
-        return bool(self.save_interval_steps) and step % self.save_interval_steps == 0
+        """True when ``step`` crosses a save-interval boundary since the last
+        query — steps may advance by more than 1 per call (steps_per_loop).
+        Seeded from the latest existing checkpoint so a resumed run does not
+        save an off-schedule checkpoint on its first dispatch."""
+        if not self.save_interval_steps:
+            return False
+        if self._last_should_save_step is None:
+            self._last_should_save_step = self.latest_step() or 0
+        crossed = (step // self.save_interval_steps
+                   > self._last_should_save_step // self.save_interval_steps)
+        self._last_should_save_step = step
+        return crossed
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()

@@ -53,11 +53,13 @@ class StepWindowTracer:
         self._active = False
         self._done = False
 
-    def on_step(self) -> None:
+    def on_step(self, steps_done: int = 1) -> None:
+        """Advance by ``steps_done`` optimizer steps (hooks fire once per
+        dispatch, which covers steps_per_loop real steps)."""
         if not self.profile_dir or self._done:
             return
         import jax
-        self._seen += 1
+        self._seen += steps_done
         if not self._active and self._seen >= self.start_step:
             jax.profiler.start_trace(self.profile_dir)
             self._active = True
@@ -92,29 +94,44 @@ class ThroughputMeter:
 
     def __init__(self, warmup_steps: int = 2):
         self.warmup_steps = warmup_steps
-        self._step_times: List[float] = []
+        self._step_times: List[float] = []  # per-step (interval / steps_done)
+        self._total_time = 0.0
         self._examples = 0
+        self._n_updates = 0
         self._n_steps = 0
+        self._drain = 0.0
         self._last = time.perf_counter()
 
-    def update(self, n_examples: int) -> None:
+    def update(self, n_examples: int, steps_done: int = 1) -> None:
+        """Record one dispatch covering ``steps_done`` optimizer steps."""
         now = time.perf_counter()
-        self._n_steps += 1
-        if self._n_steps > self.warmup_steps:  # skip compile steps
-            self._step_times.append(now - self._last)
+        self._n_updates += 1
+        self._n_steps += steps_done
+        if self._n_updates > self.warmup_steps:  # skip compile dispatches
+            interval = now - self._last
+            self._total_time += interval
+            self._step_times.append(interval / max(steps_done, 1))
             self._examples += n_examples
+        self._last = now
+
+    def record_drain(self) -> None:
+        """Fold time spent blocking on the final async-dispatched step into
+        the throughput denominator (without polluting step percentiles) —
+        call after jax.block_until_ready on the last step's outputs."""
+        now = time.perf_counter()
+        self._drain += now - self._last
         self._last = now
 
     def summary(self) -> Dict[str, float]:
         if not self._step_times:
             return {"steps": float(self._n_steps)}
         ts = sorted(self._step_times)
-        total = sum(ts)
         n = len(ts)
         return {
             "steps": float(self._n_steps),
-            "examples_per_sec": self._examples / max(total, 1e-9),
-            "step_ms_mean": 1000.0 * total / n,
+            "examples_per_sec": self._examples / max(
+                self._total_time + self._drain, 1e-9),
+            "step_ms_mean": 1000.0 * sum(ts) / n,
             "step_ms_p50": 1000.0 * ts[n // 2],
             # nearest-rank p99: ceil(0.99n)-1, not int(0.99n) (which would
             # report the max for any n <= 100)

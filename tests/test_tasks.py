@@ -182,3 +182,37 @@ class TestStreamingMode:
         assert len(seen[0]) == len(seen[1])
         # and the shards differ (disjoint records)
         assert not np.array_equal(seen[0], seen[1])
+
+
+class TestShouldSaveCrossing:
+    """should_save fires on interval crossings (steps advance by
+    steps_per_loop per query) and seeds from the latest checkpoint so a
+    resumed run does not save off-schedule."""
+
+    def test_crossing_semantics(self, tmp_path):
+        from deepfm_tpu.utils import checkpoint as ckpt_lib
+        mgr = ckpt_lib.CheckpointManager(
+            str(tmp_path / "c"), save_interval_steps=10)
+        try:
+            assert not mgr.should_save(8)
+            assert mgr.should_save(16)      # crossed 10
+            assert mgr.should_save(24)      # crossed 20
+            assert not mgr.should_save(26)
+        finally:
+            mgr.close()
+
+    def test_resume_seeds_from_latest(self, tmp_path):
+        import numpy as np
+        from deepfm_tpu.utils import checkpoint as ckpt_lib
+        d = str(tmp_path / "c")
+        mgr = ckpt_lib.CheckpointManager(d, save_interval_steps=10)
+        try:
+            mgr.save(24, {"w": np.zeros(3)})
+        finally:
+            mgr.close()
+        mgr2 = ckpt_lib.CheckpointManager(d, save_interval_steps=10)
+        try:
+            assert not mgr2.should_save(26)  # would be spurious on resume
+            assert mgr2.should_save(32)      # genuine crossing of 30
+        finally:
+            mgr2.close()
