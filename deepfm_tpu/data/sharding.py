@@ -11,7 +11,12 @@ Policy matrix (matching README-EN.md:86-91):
 
   multi_path  s3_shard   behavior
   ----------  --------   -----------------------------------------------------
-  True        *          each worker reads its private channel dir; no shard
+  True        True       each worker reads its private channel dir AND storage
+                         already sharded per host; no shard
+  True        False      private channel dir per worker, but the same channel
+                         name maps to the same storage on every host: shard
+                         across hosts (num_hosts, host_index) — reference
+                         2-hvd-gpu/...py:98-102
   False       True       storage already sharded files per host; shard the
                          host's files among its local workers by local_rank
   False       False      every worker sees all files; shard files by global
@@ -55,9 +60,20 @@ def shard_files(
     if world_size <= 1 and workers_per_host <= 1:
         return ShardSpec(files)
     if enable_data_multi_path:
-        # Reference: each worker gets its own channel (2-hvd-gpu/...py:96-99);
-        # caller already passed this worker's private file list.
-        return ShardSpec(files)
+        # Each worker gets its own channel (2-hvd-gpu/...py:376-380,403):
+        # the caller passed this worker's private file list. With S3-sharded
+        # storage that is already disjoint per host — no further shard. With
+        # replicated storage, worker i on EVERY host reads channel i, so the
+        # channel must still be split across hosts (reference :98-102).
+        if enable_s3_shard:
+            return ShardSpec(files)
+        num_hosts = max(world_size // max(workers_per_host, 1), 1)
+        if num_hosts <= 1:
+            return ShardSpec(files)
+        host_index = rank // max(workers_per_host, 1)
+        if len(files) >= num_hosts:
+            return ShardSpec(files[host_index::num_hosts])
+        return ShardSpec(files, record_shard=(num_hosts, host_index))
     if enable_s3_shard:
         # Files were distributed per host by storage (ShardedByS3Key analog,
         # deepfm-sagemaker-ps-cpu.ipynb:135). Split the host's files among its

@@ -443,13 +443,28 @@ class Trainer:
             "batches": float(n),
         }
 
+    def _local_rows(self, arr: jax.Array) -> np.ndarray:
+        """This process's rows of a data-sharded output. Fully-addressable
+        arrays (single process) fetch whole; otherwise concatenate the
+        addressable row-shards in index order, deduplicating replicas across
+        the 'model' axis."""
+        if arr.is_fully_addressable:
+            return np.asarray(jax.device_get(arr))
+        seen: Dict[int, np.ndarray] = {}
+        for s in arr.addressable_shards:
+            start = s.index[0].start or 0
+            if start not in seen:
+                seen[start] = np.asarray(s.data)
+        return np.concatenate([seen[k] for k in sorted(seen)])
+
     def predict(
         self,
         state: TrainState,
         batches: Iterable[Dict[str, np.ndarray]],
     ) -> Iterator[np.ndarray]:
-        """Yield per-batch probability vectors (reference infer task :445-449)."""
+        """Yield per-batch probability vectors for this process's rows
+        (reference infer task :445-449)."""
         step_fn = self.predict_step
         for batch in batches:
             probs = step_fn(state, self.put_batch(batch))
-            yield np.asarray(jax.device_get(probs))
+            yield self._local_rows(probs)

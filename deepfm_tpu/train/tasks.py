@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import glob as _glob
 import os
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -46,6 +46,61 @@ def resolve_files(directory: str, prefix: str) -> List[str]:
     if not files:
         files = sorted(_glob.glob(os.path.join(directory, "*.tfrecords")))
     return files
+
+
+def _channel_path(cfg: Config, name: str, *, require: bool = False) -> str:
+    """Resolve a channel name to a directory: the SageMaker-contract env var
+    ``SM_CHANNEL_<NAME>`` when set, else a ``<data_dir>/<name>`` subdirectory,
+    else ``data_dir`` itself (single-dir layouts).
+
+    ``require=True`` (multi-path channels) turns the fallback into an error:
+    silently resolving every worker's private channel to the shared
+    ``data_dir`` would make all local workers train identical records."""
+    env_key = "SM_CHANNEL_" + "".join(
+        c if c.isalnum() else "_" for c in name).upper()
+    if os.environ.get(env_key):
+        return os.environ[env_key]
+    sub = os.path.join(cfg.data_dir, name) if cfg.data_dir else ""
+    if sub and os.path.isdir(sub):
+        return sub
+    if require:
+        raise FileNotFoundError(
+            f"channel {name!r} resolves to neither ${env_key} nor "
+            f"{sub or '<data_dir>/' + name!r}; enable_data_multi_path needs "
+            f"a real private directory per training channel")
+    return cfg.data_dir
+
+
+def resolve_channel_dirs(cfg: Config, *, process_index: Optional[int] = None
+                         ) -> Tuple[str, str]:
+    """(train_dir, eval_dir) for this process from the channel layout.
+
+    Reference semantics (``2-hvd-gpu/...py:376-380,403`` + README-EN.md:78-84):
+    SM_CHANNELS arrives sorted with the eval channel FIRST; under
+    ``enable_data_multi_path`` each local worker reads its own private
+    training channel — ``channel_names[1 + local_rank]``. Without channels
+    configured this degenerates to the plain data_dir/val_data_dir pair.
+    """
+    names = cfg.channel_names
+    eval_default = cfg.val_data_dir or cfg.data_dir
+    if not names:
+        return cfg.data_dir, eval_default
+    eval_dir = (_channel_path(cfg, names[0])
+                if len(names) > 1 else eval_default)
+    train_names = names[1:] if len(names) > 1 else names
+    wph = max(cfg.worker_per_host, 1)
+    if cfg.enable_data_multi_path:
+        if len(train_names) < wph:
+            raise ValueError(
+                f"enable_data_multi_path needs one training channel per "
+                f"local worker: have {len(train_names)} channels "
+                f"{train_names} for worker_per_host={wph} "
+                f"(reference contract, README-EN.md:82)")
+        rank = jax.process_index() if process_index is None else process_index
+        train_dir = _channel_path(cfg, train_names[rank % wph], require=True)
+    else:
+        train_dir = _channel_path(cfg, train_names[0])
+    return train_dir, eval_dir
 
 
 def _local_batch_size(cfg: Config) -> int:
@@ -171,12 +226,66 @@ def run(cfg: Config) -> Dict[str, float]:
     raise ValueError(f"unknown task_type {cfg.task_type!r}")
 
 
+# Multi-process ranks only consult the (rank-local) clock at agreed dispatch
+# counts, then adopt the chief's verdict — keeping the eval collective in
+# lockstep across processes without a per-dispatch sync.
+_EVAL_CHECK_DISPATCHES = 50
+
+
+def _eval_check_due(n_dispatch: int) -> bool:
+    """Deterministic (rank-independent) schedule of clock-check dispatches:
+    powers of two early so short runs still get mid-train evals, then every
+    _EVAL_CHECK_DISPATCHES to bound sync frequency."""
+    if n_dispatch < _EVAL_CHECK_DISPATCHES:
+        return n_dispatch & (n_dispatch - 1) == 0  # 1, 2, 4, 8, 16, 32
+    return n_dispatch % _EVAL_CHECK_DISPATCHES == 0
+
+
+def _make_throttled_eval_hook(trainer: Trainer, cfg: Config,
+                              va_files: List[str], result: Dict[str, float]):
+    """Mid-train eval hook with TrainSpec/EvalSpec timing semantics
+    (start_delay_secs / throttle_secs, reference 1-ps-cpu/...py:440-441)."""
+    import time as _time
+
+    t_start = _time.time()
+    last_eval_t: List[Optional[float]] = [None]
+    n_dispatch = [0]
+    result["mid_train_evals"] = 0.0
+
+    def hook(state, m) -> None:
+        n_dispatch[0] += 1
+        multi = jax.process_count() > 1
+        if multi and not _eval_check_due(n_dispatch[0]):
+            return  # between agreed check points
+        now = _time.time()
+        due = (now - t_start >= cfg.eval_start_delay_secs
+               and (last_eval_t[0] is None
+                    or now - last_eval_t[0] >= cfg.eval_throttle_secs))
+        if multi:
+            from jax.experimental import multihost_utils  # noqa: PLC0415
+            due = bool(multihost_utils.broadcast_one_to_all(
+                np.asarray(due)))
+        if not due:
+            return
+        last_eval_t[0] = _time.time()
+        ev = trainer.evaluate(
+            state, make_pipeline(cfg, va_files, shuffle=False))
+        result["mid_train_evals"] += 1
+        result.update({"auc": ev["auc"], "eval_loss": ev["loss"]})
+        ulog.info(f"throttled eval @ step {int(state.step)}: "
+                  f"auc={ev['auc']:.5f} loss={ev['loss']:.5f}")
+
+    return hook
+
+
 def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
-    tr_files = resolve_files(cfg.data_dir, "tr")
-    va_files = resolve_files(cfg.val_data_dir or cfg.data_dir, "va")
+    train_dir, eval_dir = resolve_channel_dirs(cfg)
+    tr_files = resolve_files(train_dir, "tr")
+    va_files = resolve_files(eval_dir, "va")
     if not tr_files:
-        raise FileNotFoundError(f"no training tfrecords in {cfg.data_dir!r}")
-    ulog.info(f"train files={len(tr_files)} eval files={len(va_files)}")
+        raise FileNotFoundError(f"no training tfrecords in {train_dir!r}")
+    ulog.info(f"train dir={train_dir} files={len(tr_files)} "
+              f"eval files={len(va_files)}")
 
     if cfg.clear_existing_model and cfg.model_dir:
         ckpt_lib.clear_model_dir(cfg.model_dir)  # chief-only rmtree
@@ -192,6 +301,14 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
             cfg.model_dir, max_to_keep=cfg.keep_checkpoint_max,
             save_interval_steps=cfg.save_checkpoints_steps)
     state = _restore_or_init(trainer, cfg, require=False, mgr=mgr)
+
+    # train_and_evaluate semantics (reference 1-ps-cpu/...py:440-442,
+    # REQUIRED there per README-EN.md:36-38): mid-train eval no earlier than
+    # eval_start_delay_secs, then at most every eval_throttle_secs. With both
+    # 0 (default) the loop keeps the Horovod file-mode shape instead:
+    # eval after every epoch (2-hvd-gpu/...py:390-394).
+    eval_throttled = bool(va_files) and (
+        cfg.eval_start_delay_secs > 0 or cfg.eval_throttle_secs > 0)
 
     result: Dict[str, float] = {}
     try:
@@ -211,6 +328,9 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
         tracer = prof_lib.StepWindowTracer(
             cfg.profile_dir, num_steps=cfg.profile_steps)
         hooks.append(lambda s, m: tracer.on_step(int(m.get("steps_done", 1))))
+        if eval_throttled:
+            hooks.append(_make_throttled_eval_hook(trainer, cfg, va_files,
+                                                   result))
         try:
             if cfg.pipe_mode:
                 # Streaming (Pipe-mode analog): ONE train call consuming a
@@ -238,7 +358,7 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                     result["loss"] = fit_m["loss"]
                     result["examples_per_sec"] = fit_m.get(
                         "examples_per_sec", 0.0)
-                    if va_files:
+                    if va_files and not eval_throttled:
                         ev = trainer.evaluate(
                             state, make_pipeline(cfg, va_files, shuffle=False))
                         ulog.info(
@@ -246,6 +366,13 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                             f"{ev['auc']:.5f} loss={ev['loss']:.5f}")
                         result.update({"auc": ev["auc"],
                                        "eval_loss": ev["loss"]})
+                if va_files and eval_throttled:
+                    # Final eval at completion (train_and_evaluate does one).
+                    ev = trainer.evaluate(
+                        state, make_pipeline(cfg, va_files, shuffle=False))
+                    ulog.info(f"final eval: auc={ev['auc']:.5f} "
+                              f"loss={ev['loss']:.5f}")
+                    result.update({"auc": ev["auc"], "eval_loss": ev["loss"]})
         finally:
             tracer.close()
         if mgr is not None:
@@ -262,7 +389,8 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
 
 
 def _task_eval(trainer: Trainer, cfg: Config) -> Dict[str, float]:
-    va_files = resolve_files(cfg.val_data_dir or cfg.data_dir, "va")
+    _, eval_dir = resolve_channel_dirs(cfg)
+    va_files = resolve_files(eval_dir, "va")
     if not va_files:
         raise FileNotFoundError("no eval tfrecords found")
     state = _restore_or_init(trainer, cfg, require=True)
@@ -271,32 +399,100 @@ def _task_eval(trainer: Trainer, cfg: Config) -> Dict[str, float]:
     return ev
 
 
+def _pad_batch(batch: Dict[str, np.ndarray], bs: int) -> Dict[str, np.ndarray]:
+    """Pad a short tail batch up to the compiled shape by repeating the last
+    row (predictions for the padding are trimmed by the caller)."""
+    n = batch["label"].shape[0]
+    pad = bs - n
+    return {k: np.concatenate([v, np.tile(v[-1:], (pad,) + (1,) * (v.ndim - 1))])
+            for k, v in batch.items()}
+
+
+def _interleave_rank_shards(gathered: np.ndarray, counts: np.ndarray
+                            ) -> np.ndarray:
+    """Reassemble global record order from per-rank record-sharded results:
+    rank r held records r, r+world, r+2*world, ... so global index
+    ``i * world + r`` maps to ``gathered[r, i]``."""
+    world, _ = gathered.shape
+    out = np.empty(int(counts.sum()), dtype=gathered.dtype)
+    for r in range(world):
+        n = int(counts[r])
+        out[r:(n - 1) * world + r + 1:world] = gathered[r, :n]
+    return out
+
+
 def _task_infer(trainer: Trainer, cfg: Config) -> Dict[str, float]:
     te_files = resolve_files(cfg.val_data_dir or cfg.data_dir, "te")
     if not te_files:
         raise FileNotFoundError("no inference tfrecords found")
     state = _restore_or_init(trainer, cfg, require=True)
-    # No record-shard for inference: each process predicts the full set and
-    # the chief writes (reference writes from every worker-0, :445-449).
-    pipeline = make_pipeline(cfg, te_files, shuffle=False, sharded=False,
-                             drop_remainder=False)
-    # drop_remainder=False would change shapes; pad instead: predict on
-    # fixed-size batches and trim the tail.
-    probs: List[np.ndarray] = []
-    n_total = 0
+    world = jax.process_count()
+    rank = jax.process_index()
     local_bs = _local_batch_size(cfg)
-    for batch in pipeline:
-        n = batch["label"].shape[0]
-        n_total += n
-        if n < local_bs:  # pad tail to the compiled shape
-            pad = local_bs - n
-            batch = {k: np.concatenate([v, np.tile(v[-1:], (pad,) + (1,) * (v.ndim - 1))])
-                     for k, v in batch.items()}
-            p = next(iter(trainer.predict(state, [batch])))[:n]
-        else:
+    files = tuple(sorted(te_files))
+    # Record-level shard: each process predicts every world-th record (wall
+    # clock ~1/world of the set) and the chief re-interleaves global order
+    # before writing. (The reference had every worker predict the full set,
+    # :445-449 — O(world) redundant compute at pod scale.)
+    shard = shard_lib.ShardSpec(
+        files, record_shard=(world, rank) if world > 1 else None)
+    pipeline = pipe_lib.CtrPipeline(
+        files, field_size=cfg.field_size, batch_size=local_bs, num_epochs=1,
+        shuffle=False, shuffle_files=False, drop_remainder=False,
+        seed=cfg.seed, shard=shard, prefetch_batches=cfg.prefetch_batches,
+        use_native_decoder=cfg.use_native_decoder,
+        reader_threads=cfg.reader_threads, verify_crc=cfg.verify_crc)
+
+    # Collectives inside predict_step require every process to run the same
+    # number of rounds, but per-rank record counts can differ by one. Rather
+    # than a full counting pre-pass over the data (2x I/O), each round all
+    # ranks exchange their batch fill; a rank whose pipeline is exhausted
+    # feeds a dummy batch until every rank is done.
+    probs: List[np.ndarray] = []
+    n_local = 0
+    it = iter(pipeline)
+    if world > 1:
+        from jax.experimental import multihost_utils  # noqa: PLC0415
+        dummy = {
+            "feat_ids": np.zeros((local_bs, cfg.field_size), np.int32),
+            "feat_vals": np.zeros((local_bs, cfg.field_size), np.float32),
+            "label": np.zeros((local_bs, 1), np.float32),
+        }
+        while True:
+            batch = next(it, None)
+            n = batch["label"].shape[0] if batch is not None else 0
+            fills = np.asarray(multihost_utils.process_allgather(
+                np.asarray([n])))
+            if int(fills.sum()) == 0:
+                break  # every rank exhausted
+            if batch is None:
+                batch = dummy
+            elif n < local_bs:
+                batch = _pad_batch(batch, local_bs)
             p = next(iter(trainer.predict(state, [batch])))
-        probs.append(p)
-    all_probs = np.concatenate(probs) if probs else np.zeros((0,), np.float32)
+            if n:
+                probs.append(p[:n])
+                n_local += n
+        counts = np.asarray(multihost_utils.process_allgather(
+            np.asarray([n_local]))).reshape(-1)
+    else:
+        for batch in it:
+            n = batch["label"].shape[0]
+            n_local += n
+            if n < local_bs:  # pad tail to the compiled shape, trim after
+                batch = _pad_batch(batch, local_bs)
+            probs.append(next(iter(trainer.predict(state, [batch])))[:n])
+    local = (np.concatenate(probs) if probs
+             else np.zeros((0,), np.float32)).astype(np.float32)
+
+    if world > 1:
+        padded = np.zeros(max(int(counts.max()), 1), np.float32)
+        padded[:len(local)] = local
+        gathered = np.asarray(multihost_utils.process_allgather(padded))
+        all_probs = _interleave_rank_shards(gathered, counts)
+    else:
+        all_probs = local
+
     out_path = os.path.join(cfg.val_data_dir or cfg.data_dir, "pred.txt")
     if bootstrap.is_chief():
         with open(out_path, "w") as f:

@@ -1,0 +1,120 @@
+#!/usr/bin/env bash
+# Runnable L5 launcher: create (or reuse) a TPU slice and run a deepfm_tpu
+# task across all its hosts — the TPU-native analog of the SageMaker
+# launcher notebooks (reference 1-ps-cpu/deepfm-sagemaker-ps-cpu.ipynb:71-143:
+# pick instances, spot, distribution, channels, then estimator.fit).
+#
+# Usage:
+#   scripts/launch_slice.sh \
+#     --tpu-name deepfm-v5e --zone us-west4-a --accel-type v5litepod-8 \
+#     [--create] [--spot] [--worker-per-host N] [--repo-tar] \
+#     -- --task_type train --data_dir gs://bucket/criteo --model_dir gs://bucket/ckpt \
+#        --feature_size 117581 --field_size 39 --batch_size 1024 --num_epochs 10
+#
+# Everything after `--` is passed to the per-host entry point verbatim.
+#
+# What it does:
+#   1. (--create) gcloud creates the slice — queued-resources with --spot
+#      gives the reference's spot-instance economics (preemption tolerance =
+#      checkpoint resume, same as the reference's SageMaker spot story).
+#   2. Ships the repo to every host (--repo-tar) or assumes a shared image.
+#   3. Runs the task on ALL hosts simultaneously via
+#      `gcloud ... tpu-vm ssh --worker=all`:
+#        worker_per_host == 1 -> `python -m deepfm_tpu.launch --dist_mode 2`
+#          (jax.distributed discovers the slice topology itself)
+#        worker_per_host  > 1 -> `python -m deepfm_tpu.fanout` spawns N local
+#          processes per host with explicit rank math (MPI
+#          processes_per_host analog, ref hvd-gpu.ipynb:87-92), rendezvousing
+#          on host 0's port 12355.
+set -euo pipefail
+
+TPU_NAME=""
+ZONE=""
+ACCEL_TYPE="v5litepod-8"
+VERSION="tpu-ubuntu2204-base"
+CREATE=0
+SPOT=0
+WORKER_PER_HOST=1
+SHIP_REPO=0
+COORD_PORT=12355
+
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    --tpu-name) TPU_NAME="$2"; shift 2 ;;
+    --zone) ZONE="$2"; shift 2 ;;
+    --accel-type) ACCEL_TYPE="$2"; shift 2 ;;
+    --version) VERSION="$2"; shift 2 ;;
+    --create) CREATE=1; shift ;;
+    --spot) SPOT=1; shift ;;
+    --worker-per-host) WORKER_PER_HOST="$2"; shift 2 ;;
+    --repo-tar) SHIP_REPO=1; shift ;;
+    --) shift; break ;;
+    *) echo "unknown flag: $1" >&2; exit 2 ;;
+  esac
+done
+TASK_ARGS=("$@")
+
+[[ -n "$TPU_NAME" && -n "$ZONE" ]] || {
+  echo "required: --tpu-name and --zone" >&2; exit 2; }
+
+GC=(gcloud compute tpus tpu-vm)
+
+if [[ "$CREATE" == 1 ]]; then
+  echo ">> creating TPU slice $TPU_NAME ($ACCEL_TYPE) in $ZONE"
+  CREATE_FLAGS=(--zone "$ZONE" --accelerator-type "$ACCEL_TYPE"
+                --version "$VERSION")
+  [[ "$SPOT" == 1 ]] && CREATE_FLAGS+=(--spot)
+  "${GC[@]}" create "$TPU_NAME" "${CREATE_FLAGS[@]}"
+fi
+
+# Host topology from the slice description.
+NUM_HOSTS=$("${GC[@]}" describe "$TPU_NAME" --zone "$ZONE" \
+              --format='value(networkEndpoints.length())')
+HOST0_IP=$("${GC[@]}" describe "$TPU_NAME" --zone "$ZONE" \
+             --format='value(networkEndpoints[0].ipAddress)')
+echo ">> slice $TPU_NAME: $NUM_HOSTS host(s), host0=$HOST0_IP, " \
+     "worker_per_host=$WORKER_PER_HOST"
+
+if [[ "$SHIP_REPO" == 1 ]]; then
+  echo ">> shipping repo to all hosts"
+  REPO_ROOT=$(cd "$(dirname "$0")/.." && pwd)
+  TAR=/tmp/deepfm_tpu_ship.tgz
+  tar -czf "$TAR" -C "$REPO_ROOT" --exclude .git --exclude '__pycache__' .
+  "${GC[@]}" scp "$TAR" "$TPU_NAME":/tmp/ --zone "$ZONE" --worker=all
+  "${GC[@]}" ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
+    --command="mkdir -p ~/deepfm_tpu_run && tar -xzf /tmp/deepfm_tpu_ship.tgz -C ~/deepfm_tpu_run"
+fi
+
+QUOTED_ARGS=$(printf ' %q' "${TASK_ARGS[@]}")
+
+if [[ "$WORKER_PER_HOST" == 1 ]]; then
+  # One process per host: jax.distributed discovers the slice topology.
+  REMOTE_CMD="cd ~/deepfm_tpu_run 2>/dev/null || true; \
+python -m deepfm_tpu.launch --dist_mode 2 --worker_per_host 1$QUOTED_ARGS"
+  echo ">> running on all hosts: $REMOTE_CMD"
+  "${GC[@]}" ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
+    --command="$REMOTE_CMD"
+else
+  # N processes per host: fanout computes per-process ranks; every host
+  # rendezvouses on host 0.
+  echo ">> fanning out $WORKER_PER_HOST workers/host across $NUM_HOSTS hosts"
+  PIDS=()
+  for (( h=0; h<NUM_HOSTS; h++ )); do
+    REMOTE_CMD="cd ~/deepfm_tpu_run 2>/dev/null || true; \
+python -m deepfm_tpu.fanout --worker_per_host $WORKER_PER_HOST \
+--num_hosts $NUM_HOSTS --host_index $h \
+--coordinator_address $HOST0_IP:$COORD_PORT$QUOTED_ARGS"
+    "${GC[@]}" ssh "$TPU_NAME" --zone "$ZONE" --worker="$h" \
+      --command="$REMOTE_CMD" &
+    PIDS+=($!)
+  done
+  RC=0
+  for (( h=0; h<NUM_HOSTS; h++ )); do
+    if ! wait "${PIDS[$h]}"; then
+      echo ">> host $h FAILED" >&2
+      RC=1
+    fi
+  done
+  [[ "$RC" == 0 ]] || { echo ">> launch failed" >&2; exit "$RC"; }
+fi
+echo ">> done"

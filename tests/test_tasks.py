@@ -216,3 +216,180 @@ class TestShouldSaveCrossing:
             assert mgr2.should_save(32)      # genuine crossing of 30
         finally:
             mgr2.close()
+
+
+class TestChannelWiring:
+    """Per-rank channel resolution (reference 2-hvd-gpu/...py:376-380,403:
+    SM_CHANNELS sorted eval-first; multi_path = one private training channel
+    per local worker)."""
+
+    def _cfg(self, tmp_path, **kw):
+        from deepfm_tpu.config import Config
+        base = dict(
+            data_dir=str(tmp_path), feature_size=300, field_size=5,
+            embedding_size=8, deep_layers="16,8", dropout="1.0,1.0",
+            batch_size=32, log_steps=0)
+        base.update(kw)
+        return Config(**base)
+
+    def test_no_channels_falls_back_to_dirs(self, tmp_path):
+        from deepfm_tpu.train.tasks import resolve_channel_dirs
+        cfg = self._cfg(tmp_path, val_data_dir="/va")
+        assert resolve_channel_dirs(cfg) == (str(tmp_path), "/va")
+
+    def test_eval_channel_is_first(self, tmp_path):
+        from deepfm_tpu.train.tasks import resolve_channel_dirs
+        for name in ("evaluation", "training"):
+            (tmp_path / name).mkdir()
+        cfg = self._cfg(tmp_path, channels='["evaluation", "training"]')
+        tr, ev = resolve_channel_dirs(cfg)
+        assert tr == str(tmp_path / "training")
+        assert ev == str(tmp_path / "evaluation")
+
+    def test_multi_path_ranks_read_disjoint_dirs(self, tmp_path):
+        from deepfm_tpu.train.tasks import resolve_channel_dirs
+        for name in ("evaluation", "train-1", "train-2"):
+            (tmp_path / name).mkdir()
+        cfg = self._cfg(
+            tmp_path, channels='["evaluation", "train-1", "train-2"]',
+            enable_data_multi_path=True, worker_per_host=2)
+        tr0, _ = resolve_channel_dirs(cfg, process_index=0)
+        tr1, _ = resolve_channel_dirs(cfg, process_index=1)
+        tr2, _ = resolve_channel_dirs(cfg, process_index=2)  # host 1 worker 0
+        assert tr0 == str(tmp_path / "train-1")
+        assert tr1 == str(tmp_path / "train-2")
+        assert tr0 != tr1
+        assert tr2 == tr0  # same local_rank on the next host -> same channel
+
+    def test_multi_path_requires_channel_per_worker(self, tmp_path):
+        import pytest as _pytest
+        from deepfm_tpu.train.tasks import resolve_channel_dirs
+        cfg = self._cfg(
+            tmp_path, channels='["evaluation", "train-1"]',
+            enable_data_multi_path=True, worker_per_host=4)
+        with _pytest.raises(ValueError, match="one training channel per"):
+            resolve_channel_dirs(cfg, process_index=0)
+
+    def test_sm_channel_env_override(self, tmp_path, monkeypatch):
+        from deepfm_tpu.train.tasks import resolve_channel_dirs
+        monkeypatch.setenv("SM_CHANNEL_TRAIN_1", "/mnt/ch/t1")
+        cfg = self._cfg(tmp_path, channels='["evaluation", "train-1"]',
+                        enable_data_multi_path=True, worker_per_host=1)
+        tr, _ = resolve_channel_dirs(cfg, process_index=0)
+        assert tr == "/mnt/ch/t1"
+
+    def test_train_task_reads_channel_dirs(self, tmp_path):
+        from deepfm_tpu.data import libsvm
+        from deepfm_tpu.train import tasks
+        libsvm.generate_synthetic_ctr(
+            str(tmp_path / "train-1"), num_files=2, examples_per_file=128,
+            feature_size=300, field_size=5, prefix="tr", seed=5)
+        libsvm.generate_synthetic_ctr(
+            str(tmp_path / "evaluation"), num_files=1, examples_per_file=64,
+            feature_size=300, field_size=5, prefix="va", seed=6)
+        cfg = self._cfg(
+            tmp_path, channels='["evaluation", "train-1"]',
+            enable_data_multi_path=True, worker_per_host=1,
+            num_epochs=1, mesh_data=1)
+        result = tasks.run(cfg)
+        assert result["steps"] == 2 * 128 // 32
+        assert "auc" in result  # eval channel was found and used
+
+
+class TestMultiPathHostShard:
+    def test_multi_path_no_s3_shards_across_hosts(self):
+        from deepfm_tpu.data import sharding
+        files = [f"f{i}" for i in range(4)]
+        # 2 hosts x 2 workers; same channel replicated across hosts.
+        s_h0 = sharding.shard_files(
+            files, enable_data_multi_path=True, enable_s3_shard=False,
+            rank=0, local_rank=0, world_size=4, workers_per_host=2)
+        s_h1 = sharding.shard_files(
+            files, enable_data_multi_path=True, enable_s3_shard=False,
+            rank=2, local_rank=0, world_size=4, workers_per_host=2)
+        assert set(s_h0.files) | set(s_h1.files) == set(files)
+        assert not set(s_h0.files) & set(s_h1.files)
+        # s3-sharded storage: already disjoint, no further split.
+        s = sharding.shard_files(
+            files, enable_data_multi_path=True, enable_s3_shard=True,
+            rank=2, local_rank=0, world_size=4, workers_per_host=2)
+        assert s.files == tuple(sorted(files))
+
+
+class TestThrottledEval:
+    """train_and_evaluate timing semantics (reference 1-ps-cpu/...py:440-442):
+    first eval no earlier than eval_start_delay_secs, then at most every
+    eval_throttle_secs."""
+
+    def _setup(self, tmp_path):
+        from deepfm_tpu.config import Config
+        from deepfm_tpu.data import libsvm
+        from deepfm_tpu.train import Trainer
+        libsvm.generate_synthetic_ctr(
+            str(tmp_path), num_files=1, examples_per_file=64,
+            feature_size=300, field_size=5, prefix="va", seed=7)
+        cfg = Config(
+            data_dir=str(tmp_path), feature_size=300, field_size=5,
+            embedding_size=8, deep_layers="16,8", dropout="1.0,1.0",
+            batch_size=32, log_steps=0, mesh_data=1,
+            eval_start_delay_secs=10, eval_throttle_secs=5)
+        trainer = Trainer(cfg)
+        state = trainer.init_state()
+        return cfg, trainer, state
+
+    def test_hook_timing(self, tmp_path, monkeypatch):
+        import time as time_mod
+        from deepfm_tpu.train import tasks
+        cfg, trainer, state = self._setup(tmp_path)
+        va_files = tasks.resolve_files(str(tmp_path), "va")
+
+        clock = [1000.0]
+        monkeypatch.setattr(time_mod, "time", lambda: clock[0])
+        result = {}
+        hook = tasks._make_throttled_eval_hook(trainer, cfg, va_files, result)
+
+        clock[0] = 1005.0
+        hook(state, {})                      # before start_delay: no eval
+        assert result["mid_train_evals"] == 0
+        clock[0] = 1011.0
+        hook(state, {})                      # past start_delay: first eval
+        assert result["mid_train_evals"] == 1
+        assert "auc" in result
+        clock[0] = 1013.0
+        hook(state, {})                      # within throttle window: skipped
+        assert result["mid_train_evals"] == 1
+        clock[0] = 1017.0
+        hook(state, {})                      # throttle elapsed: second eval
+        assert result["mid_train_evals"] == 2
+
+    def test_train_task_respects_start_delay(self, tmp_path):
+        from deepfm_tpu.config import Config
+        from deepfm_tpu.data import libsvm
+        from deepfm_tpu.train import tasks
+        libsvm.generate_synthetic_ctr(
+            str(tmp_path), num_files=1, examples_per_file=128,
+            feature_size=300, field_size=5, prefix="tr", seed=8)
+        libsvm.generate_synthetic_ctr(
+            str(tmp_path), num_files=1, examples_per_file=64,
+            feature_size=300, field_size=5, prefix="va", seed=9)
+        cfg = Config(
+            data_dir=str(tmp_path), feature_size=300, field_size=5,
+            embedding_size=8, deep_layers="16,8", dropout="1.0,1.0",
+            batch_size=32, log_steps=0, num_epochs=2, mesh_data=1,
+            eval_start_delay_secs=10_000, eval_throttle_secs=10_000)
+        result = tasks.run(cfg)
+        assert result["mid_train_evals"] == 0   # delay never elapsed
+        assert "auc" in result                  # but the final eval ran
+
+
+def test_interleave_rank_shards():
+    import numpy as np
+    from deepfm_tpu.train.tasks import _interleave_rank_shards
+    # world=2, rank0 held records 0,2,4,6 (4), rank1 held 1,3,5 (3)
+    gathered = np.array([[0., 2., 4., 6.], [1., 3., 5., 0.]], np.float32)
+    out = _interleave_rank_shards(gathered, np.array([4, 3]))
+    np.testing.assert_array_equal(out, np.arange(7, dtype=np.float32))
+    # equal counts
+    g = np.array([[0., 3.], [1., 4.], [2., 5.]], np.float32)
+    out = _interleave_rank_shards(g, np.array([2, 2, 2]))
+    np.testing.assert_array_equal(out, np.arange(6, dtype=np.float32))
