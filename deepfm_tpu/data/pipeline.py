@@ -71,36 +71,43 @@ def _native_loader():
     return None
 
 
-def _iter_framed_chunks(path: str, loader, verify_crc: bool = True
+def _iter_framed_stream(stream: BinaryIO, loader, verify_crc: bool = True
                         ) -> Iterator[Tuple[bytes, np.ndarray, np.ndarray]]:
     """Chunked read() + C-speed framing with a carried partial tail: yields
-    (buf, offsets, lengths) per chunk. Constant memory on multi-GB shards,
-    and plain file I/O errors stay catchable Python exceptions (an mmap
-    would turn them into SIGBUS). The single framing state machine shared by
-    the record iterator and the vectorized decode path."""
+    (buf, offsets, lengths) per chunk from any sequential byte source.
+    Constant memory on multi-GB inputs, and plain I/O errors stay catchable
+    Python exceptions (an mmap would turn them into SIGBUS). The single
+    framing state machine shared by the record iterator, the vectorized
+    file path, and the streaming (Pipe-mode) path."""
+    carry = b""
+    read_size = _NATIVE_CHUNK_BYTES
+    while True:
+        chunk = stream.read(read_size)
+        if not chunk:
+            if carry:
+                # Strict parse of the leftover: surfaces truncated-input
+                # as an error, not silence.
+                offsets, lengths = loader.split_frames(
+                    carry, verify_crc=verify_crc)
+                yield carry, offsets, lengths
+            return
+        buf = carry + chunk if carry else chunk
+        offsets, lengths, consumed = loader.split_frames_partial(
+            buf, verify_crc=verify_crc)
+        yield buf, offsets, lengths
+        carry = buf[consumed:]
+        # A record larger than the read size frames nothing (consumed=0);
+        # double the next read so it completes in O(n) total copying
+        # rather than O(n^2) re-copies of the growing carry.
+        read_size = (_NATIVE_CHUNK_BYTES if consumed
+                     else max(read_size * 2, _NATIVE_CHUNK_BYTES))
+
+
+def _iter_framed_chunks(path: str, loader, verify_crc: bool = True
+                        ) -> Iterator[Tuple[bytes, np.ndarray, np.ndarray]]:
+    """File-path front-end of ``_iter_framed_stream``."""
     with open(path, "rb") as f:
-        carry = b""
-        read_size = _NATIVE_CHUNK_BYTES
-        while True:
-            chunk = f.read(read_size)
-            if not chunk:
-                if carry:
-                    # Strict parse of the leftover: surfaces truncated-file
-                    # as an error, not silence.
-                    offsets, lengths = loader.split_frames(
-                        carry, verify_crc=verify_crc)
-                    yield carry, offsets, lengths
-                return
-            buf = carry + chunk if carry else chunk
-            offsets, lengths, consumed = loader.split_frames_partial(
-                buf, verify_crc=verify_crc)
-            yield buf, offsets, lengths
-            carry = buf[consumed:]
-            # A record larger than the read size frames nothing (consumed=0);
-            # double the next read so it completes in O(n) total copying
-            # rather than O(n^2) re-copies of the growing carry.
-            read_size = (_NATIVE_CHUNK_BYTES if consumed
-                         else max(read_size * 2, _NATIVE_CHUNK_BYTES))
+        yield from _iter_framed_stream(f, loader, verify_crc)
 
 
 def _iter_file_records(path: str, use_native: bool, verify_crc: bool = True
@@ -439,6 +446,7 @@ class StreamingCtrPipeline:
         self.batch_size = batch_size
         self.drop_remainder = drop_remainder
         self.prefetch_batches = prefetch_batches
+        self._use_native = use_native_decoder
         self._decode = _get_decoder(use_native_decoder)
         self._record_shard = record_shard
         self.verify_crc = verify_crc
@@ -458,12 +466,39 @@ class StreamingCtrPipeline:
             if i % world == rank:
                 yield rec
 
-    def _iter_sync(self) -> Iterator[Batch]:
-        if self._consumed:
-            raise RuntimeError(
-                "StreamingCtrPipeline is single-pass (Pipe-mode FIFO semantics); "
-                "create a new stream for another epoch")
-        self._consumed = True
+    def _iter_vectorized(self, loader) -> Iterator[Batch]:
+        """Native streaming fast path: C-speed chunked framing + vectorized
+        decode straight off the byte stream — the same machinery as the
+        file path (the reference's PipeModeDataset is a C++ reader, X3;
+        round 1 framed pipe-mode records one-by-one in Python)."""
+        bs = self.batch_size
+        pend: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+        n_pend = 0
+        n_seen = 0
+        for buf, offsets, lengths in _iter_framed_stream(
+                self.stream, loader, self.verify_crc):
+            if len(offsets) == 0:
+                continue
+            labels, ids, vals = loader.decode_spans(
+                buf, offsets, lengths, self.field_size)
+            if self._record_shard is not None:
+                world, rank = self._record_shard
+                keep = (np.arange(n_seen, n_seen + len(labels))
+                        % world) == rank
+                labels, ids, vals = labels[keep], ids[keep], vals[keep]
+            n_seen += len(offsets)
+            if not len(labels):
+                continue
+            pend.append((labels, ids, vals))
+            n_pend += len(labels)
+            while n_pend >= bs:
+                yield CtrPipeline._assemble_batch(pend, bs)
+                n_pend -= bs
+        if n_pend and not self.drop_remainder:
+            yield CtrPipeline._assemble_batch(pend, n_pend)
+
+    def _iter_record_batches(self) -> Iterator[Batch]:
+        """Pure-Python fallback: per-record framing + batched decode."""
         pending: List[bytes] = []
         for rec in self._iter_records():
             pending.append(rec)
@@ -482,6 +517,18 @@ class StreamingCtrPipeline:
                 "feat_vals": np.ascontiguousarray(vals, np.float32),
                 "label": labels.reshape(-1, 1).astype(np.float32),
             }
+
+    def _iter_sync(self) -> Iterator[Batch]:
+        if self._consumed:
+            raise RuntimeError(
+                "StreamingCtrPipeline is single-pass (Pipe-mode FIFO semantics); "
+                "create a new stream for another epoch")
+        self._consumed = True
+        loader = _native_loader() if self._use_native else None
+        if loader is not None:
+            yield from self._iter_vectorized(loader)
+        else:
+            yield from self._iter_record_batches()
 
     def __iter__(self) -> Iterator[Batch]:
         if self.prefetch_batches <= 0:

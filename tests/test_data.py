@@ -206,10 +206,27 @@ class TestShardPolicy:
         ]
         sharding.validate_shard_coverage(specs, self.FILES)
 
-    def test_multi_path_no_shard(self):
+    def test_multi_path_s3_sharded_no_shard(self):
+        # multi_path + S3-sharded storage: channel already disjoint per
+        # host — read everything (README-EN.md:88 row 1).
         s = sharding.shard_files(
-            self.FILES, enable_data_multi_path=True, rank=3, world_size=4)
+            self.FILES, enable_data_multi_path=True, enable_s3_shard=True,
+            rank=3, world_size=4)
         assert s.files == tuple(sorted(self.FILES))
+
+    def test_multi_path_replicated_storage_shards_by_host(self):
+        # multi_path + replicated storage: worker i on EVERY host reads
+        # channel i, so hosts must split it (README-EN.md:89 row 2;
+        # reference 2-hvd-gpu/...py:98-102).
+        specs = [
+            sharding.shard_files(
+                self.FILES, enable_data_multi_path=True,
+                enable_s3_shard=False, rank=r, world_size=4,
+                workers_per_host=1)
+            for r in range(4)
+        ]
+        sharding.validate_shard_coverage(specs, self.FILES)
+        assert all(len(s.files) < len(self.FILES) for s in specs)
 
 
 class TestPipeline:
@@ -301,3 +318,65 @@ class TestPipeline:
             use_native_decoder=False)
         with pytest.raises(IOError):
             list(p)
+
+
+class TestNativeStreaming:
+    """Pipe-mode fast path: chunked C framing + vectorized decode off the
+    byte stream must be record-for-record identical to the pure-Python
+    framer (order, sharding, tail handling)."""
+
+    @pytest.fixture()
+    def data_dir(self, tmp_path):
+        libsvm.generate_synthetic_ctr(
+            str(tmp_path), num_files=3, examples_per_file=50,
+            feature_size=200, field_size=6, seed=1)
+        return tmp_path
+
+    def _files(self, data_dir):
+        import glob as _g
+        return sorted(_g.glob(str(data_dir / "*.tfrecords")))
+
+    def _run(self, data_dir, native, record_shard=None, drop_remainder=False):
+        files = self._files(data_dir)
+        raw = b"".join(open(f, "rb").read() for f in files)
+        sp = pipeline.StreamingCtrPipeline(
+            io.BytesIO(raw), field_size=6, batch_size=25,
+            use_native_decoder=native, record_shard=record_shard,
+            drop_remainder=drop_remainder, prefetch_batches=0)
+        return list(sp)
+
+    @pytest.mark.skipif(not pipeline._native_loader(),
+                        reason="native decoder unavailable")
+    @pytest.mark.parametrize("record_shard", [None, (2, 0), (2, 1), (3, 2)])
+    def test_native_matches_python(self, data_dir, record_shard):
+        native = self._run(data_dir, True, record_shard)
+        python = self._run(data_dir, False, record_shard)
+        assert len(native) == len(python)
+        for bn, bp in zip(native, python):
+            np.testing.assert_array_equal(bn["feat_ids"], bp["feat_ids"])
+            np.testing.assert_array_equal(bn["feat_vals"], bp["feat_vals"])
+            np.testing.assert_array_equal(bn["label"], bp["label"])
+
+    @pytest.mark.skipif(not pipeline._native_loader(),
+                        reason="native decoder unavailable")
+    def test_native_small_chunks_cross_boundaries(self, data_dir, monkeypatch):
+        # Force tiny reads so records straddle chunk boundaries constantly.
+        monkeypatch.setattr(pipeline, "_NATIVE_CHUNK_BYTES", 64)
+        native = self._run(data_dir, True)
+        monkeypatch.setattr(pipeline, "_NATIVE_CHUNK_BYTES", 64 << 20)
+        python = self._run(data_dir, False)
+        assert len(native) == len(python)
+        for bn, bp in zip(native, python):
+            np.testing.assert_array_equal(bn["feat_ids"], bp["feat_ids"])
+
+    @pytest.mark.skipif(not pipeline._native_loader(),
+                        reason="native decoder unavailable")
+    def test_native_single_pass_guard(self, data_dir):
+        files = self._files(data_dir)
+        raw = b"".join(open(f, "rb").read() for f in files)
+        sp = pipeline.StreamingCtrPipeline(
+            io.BytesIO(raw), field_size=6, batch_size=25,
+            use_native_decoder=True)
+        assert len(list(sp)) == 6
+        with pytest.raises(RuntimeError):
+            list(sp)
