@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Step-time breakdown + batch-size sweep on the current accelerator.
+
+Produces the README Performance table: device-bound cost of each stage
+(forward, forward+backward+update, K-step scan, host->device transfer) and
+an ms/step vs batch-size sweep, plus a Pallas-vs-XLA A/B. Optionally writes
+a jax.profiler trace (--trace_dir) for TensorBoard/Perfetto inspection.
+
+Usage: python scripts/profile_step.py [--trace_dir /tmp/trace] [--quick]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+K = 8
+
+
+def _batches(cfg, n, bs):
+    rng = np.random.default_rng(0)
+    out = []
+    for _ in range(n):
+        out.append({
+            "feat_ids": rng.integers(
+                0, cfg.feature_size, (bs, cfg.field_size)).astype(np.int32),
+            "feat_vals": rng.normal(
+                size=(bs, cfg.field_size)).astype(np.float32),
+            "label": (rng.random((bs, 1)) < 0.25).astype(np.float32),
+        })
+    return out
+
+
+def _time(fn, n_iters, args_fn) -> float:
+    """Best-of-3 wall ms per call of fn(args_fn())."""
+    import jax
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(n_iters):
+            out = fn(args_fn())
+        jax.block_until_ready(out)
+        best = min(best, (time.perf_counter() - t0) / n_iters)
+    return 1000 * best
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trace_dir", default="")
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    from deepfm_tpu.config import Config
+    from deepfm_tpu.train import Trainer
+    from deepfm_tpu.utils import profiling as prof_lib
+
+    def cfg_for(bs, use_pallas=True):
+        return Config(
+            feature_size=117581, field_size=39, embedding_size=32,
+            deep_layers="128,64,32", dropout="0.5,0.5,0.5", batch_size=bs,
+            learning_rate=5e-4, optimizer="Adam", l2_reg=1e-4,
+            compute_dtype="bfloat16", log_steps=0, seed=0,
+            use_pallas=use_pallas, steps_per_loop=K)
+
+    print(f"devices: {jax.devices()}  backend: {jax.default_backend()}\n")
+
+    # ---- breakdown at the reference batch size -------------------------
+    bs = 1024
+    cfg = cfg_for(bs)
+    tr = Trainer(cfg)
+    state = tr.init_state()
+    host = _batches(cfg, 8, bs)
+    dev = [tr.put_batch(b) for b in host]
+    sb_host = [host[i:i + K] for i in (0,)]
+    sb_dev = tr.put_superbatch(sb_host[0])
+
+    # warmup/compile all programs
+    probs = tr.predict_step(state, dev[0])
+    state, m = tr.train_step(state, dev[1])
+    state, m = tr.multi_step(state, sb_dev)
+    jax.block_until_ready((probs, m["loss"]))
+
+    n = 30 if args.quick else 100
+    i = [0]
+
+    def next_dev():
+        i[0] = (i[0] + 1) % 8
+        return dev[i[0]]
+
+    t_fwd = _time(lambda b: tr.predict_step(state, b), n, next_dev)
+    st = [state]
+
+    def step1(b):
+        st[0], mm = tr.train_step(st[0], b)
+        return mm["loss"]
+    t_step = _time(step1, n, next_dev)
+
+    def stepk(sbx):
+        st[0], mm = tr.multi_step(st[0], sbx)
+        return mm["loss"]
+    t_scan = _time(stepk, max(n // K, 5),
+                   lambda: tr.put_superbatch(sb_host[0]))
+    t_put1 = _time(lambda b: jax.tree.map(lambda x: x, tr.put_batch(b)),
+                   n, lambda: host[i[0] % 8])
+    t_putk = _time(lambda g: tr.put_superbatch(g), max(n // K, 5),
+                   lambda: sb_host[0])
+
+    print("stage breakdown @ batch 1024 (best-of-3, ms):")
+    print(f"  forward only (predict_step, staged)        {t_fwd:8.3f}")
+    print(f"  fwd+bwd+Adam (train_step, staged)          {t_step:8.3f}")
+    print(f"  host->device transfer, one batch           {t_put1:8.3f}")
+    print(f"  K={K} steps: one stacked transfer           {t_putk:8.3f}"
+          f"  ({t_putk / K:.3f}/step)")
+    print(f"  K={K} steps: scan dispatch incl. transfer   {t_scan:8.3f}"
+          f"  ({t_scan / K:.3f}/step)")
+
+    # ---- batch-size sweep ---------------------------------------------
+    print("\nbatch-size sweep (train_step, staged batches, ms/step | ex/s):")
+    for bs in (256, 1024, 4096, 16384):
+        c = cfg_for(bs)
+        t2 = Trainer(c)
+        s2 = t2.init_state()
+        d2 = [t2.put_batch(b) for b in _batches(c, 4, bs)]
+        s2, mm = t2.train_step(s2, d2[0])
+        jax.block_until_ready(mm["loss"])
+        holder = [s2]
+
+        def one(b, holder=holder, t2=t2):
+            holder[0], m3 = t2.train_step(holder[0], b)
+            return m3["loss"]
+        j = [0]
+
+        def nxt(d2=d2, j=j):
+            j[0] = (j[0] + 1) % 4
+            return d2[j[0]]
+        ms = _time(one, 20 if args.quick else 50, nxt)
+        print(f"  bs={bs:6d}: {ms:7.3f} ms/step  {1000 * bs / ms:12,.0f} ex/s")
+
+    # ---- Pallas A/B ----------------------------------------------------
+    print("\nPallas fused FM vs XLA formulation (train_step, staged):")
+    for pallas in (True, False):
+        c = cfg_for(1024, use_pallas=pallas)
+        t2 = Trainer(c)
+        s2 = t2.init_state()
+        d2 = [t2.put_batch(b) for b in _batches(c, 4, 1024)]
+        s2, mm = t2.train_step(s2, d2[0])
+        jax.block_until_ready(mm["loss"])
+        holder = [s2]
+
+        def one(b, holder=holder, t2=t2):
+            holder[0], m3 = t2.train_step(holder[0], b)
+            return m3["loss"]
+        j = [0]
+
+        def nxt(d2=d2, j=j):
+            j[0] = (j[0] + 1) % 4
+            return d2[j[0]]
+        ms = _time(one, 20 if args.quick else 50, nxt)
+        print(f"  use_pallas={pallas}: {ms:7.3f} ms/step")
+
+    # ---- optional trace ------------------------------------------------
+    if args.trace_dir:
+        with prof_lib.maybe_trace(args.trace_dir):
+            for _ in range(10):
+                st[0], mm = tr.multi_step(st[0], tr.put_superbatch(sb_host[0]))
+            jax.block_until_ready(mm["loss"])
+        print(f"\ntrace written under {args.trace_dir} "
+              "(TensorBoard: profile plugin / Perfetto: xplane)")
+
+
+if __name__ == "__main__":
+    main()
