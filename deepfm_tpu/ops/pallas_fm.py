@@ -78,22 +78,25 @@ def _block_specs(bt: int, f: int, k: int, memory_space):
 
 def _fwd_kernel(w_ref, vals_ref, xv_ref, out_ref):
     # All intermediates stay >= 2-D (rank-1 vectors break Mosaic layout
-    # inference on TPU).
-    xv = xv_ref[:]                                         # [Bt, F, K]
+    # inference on TPU). Inputs may be bf16 in HBM/VMEM; accumulate in f32
+    # (cast after load — keeps HBM traffic and residuals at bf16 width).
+    xv = xv_ref[:].astype(jnp.float32)                     # [Bt, F, K]
     s = jnp.sum(xv, axis=1)                                # [Bt, K]
     sum_sq = jnp.sum(s * s, axis=1, keepdims=True)         # [Bt, 1]
     sq_sum = jnp.sum(jnp.sum(xv * xv, axis=1), axis=1, keepdims=True)
-    y_w = jnp.sum(w_ref[:] * vals_ref[:], axis=1, keepdims=True)  # [Bt, 1]
+    y_w = jnp.sum(w_ref[:].astype(jnp.float32)
+                  * vals_ref[:].astype(jnp.float32), axis=1, keepdims=True)
     out_ref[:] = y_w + 0.5 * (sum_sq - sq_sum)
 
 
 def _bwd_kernel(g_ref, w_ref, vals_ref, xv_ref, dw_ref, dvals_ref, dxv_ref):
-    g = g_ref[:]                                           # [Bt, 1]
-    xv = xv_ref[:]
+    g = g_ref[:]                                           # [Bt, 1] f32
+    xv = xv_ref[:].astype(jnp.float32)
     s = jnp.sum(xv, axis=1)                                # [Bt, K]
-    dw_ref[:] = vals_ref[:] * g
-    dvals_ref[:] = w_ref[:] * g
-    dxv_ref[:] = (s[:, None, :] - xv) * g[:, :, None]      # d(y_v)/d(xv) * g
+    dw_ref[:] = (vals_ref[:].astype(jnp.float32) * g).astype(dw_ref.dtype)
+    dvals_ref[:] = (w_ref[:].astype(jnp.float32) * g).astype(dvals_ref.dtype)
+    # d(y_v)/d(xv) * g
+    dxv_ref[:] = ((s[:, None, :] - xv) * g[:, :, None]).astype(dxv_ref.dtype)
 
 
 def _pad_b(x: jnp.ndarray, b_pad: int) -> jnp.ndarray:
@@ -144,9 +147,11 @@ def _run_bwd(g, w, vals, xv, interpret: bool):
             pl.BlockSpec((bt, f, k), lambda i: (i, 0, 0), **kw),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bp, f), jnp.float32),
-            jax.ShapeDtypeStruct((bp, f), jnp.float32),
-            jax.ShapeDtypeStruct((bp, f, k), jnp.float32),
+            # Cotangent dtypes mirror the primals (bf16 in -> bf16 grads),
+            # written directly by the kernel — no f32 round trip in HBM.
+            jax.ShapeDtypeStruct((bp, f), w.dtype),
+            jax.ShapeDtypeStruct((bp, f), vals.dtype),
+            jax.ShapeDtypeStruct((bp, f, k), xv.dtype),
         ],
         interpret=interpret,
     )(g2, w, vals, xv)
@@ -156,21 +161,21 @@ def _run_bwd(g, w, vals, xv, interpret: bool):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def fused_fm(w: jnp.ndarray, vals: jnp.ndarray, xv: jnp.ndarray,
              interpret: bool = False) -> jnp.ndarray:
-    """Fused y_w + y_v.  w: [B,F], vals: [B,F], xv: [B,F,K] -> [B] (f32)."""
-    return _run_fwd(w.astype(jnp.float32), vals.astype(jnp.float32),
-                    xv.astype(jnp.float32), interpret)
+    """Fused y_w + y_v.  w: [B,F], vals: [B,F], xv: [B,F,K] -> [B] (f32).
+
+    Inputs may be bf16: the kernels cast to f32 AFTER the VMEM load, so
+    residuals saved for the backward pass stay at bf16 width in HBM (the
+    r1 version saved f32 copies — 2x the residual memory)."""
+    return _run_fwd(w, vals, xv, interpret)
 
 
 def _fused_fm_fwd(w, vals, xv, interpret):
-    w32 = w.astype(jnp.float32)
-    x32 = vals.astype(jnp.float32)
-    xv32 = xv.astype(jnp.float32)
-    return _run_fwd(w32, x32, xv32, interpret), (w32, x32, xv32)
+    return _run_fwd(w, vals, xv, interpret), (w, vals, xv)
 
 
 def _fused_fm_bwd(interpret, res, g):
-    w32, x32, xv32 = res
-    dw, dvals, dxv = _run_bwd(g, w32, x32, xv32, interpret)
+    w, vals, xv = res
+    dw, dvals, dxv = _run_bwd(g.astype(jnp.float32), w, vals, xv, interpret)
     return dw, dvals, dxv
 
 

@@ -85,3 +85,31 @@ def test_vmem_gate_blocks_oversized_shapes():
     # Absurd shapes don't fit at any tile -> compiled path gated off.
     assert pallas_fm._pick_block_b(4096, 512) == 0
     assert not pallas_fm.supported(4096, 512)
+
+
+def test_bf16_residuals_and_grad_dtypes():
+    """bf16 inputs keep bf16 residuals/grads (ADVICE r1: the VJP used to
+    save f32 copies, doubling residual HBM)."""
+    rng = np.random.default_rng(5)
+    w = jnp.asarray(rng.normal(size=(16, 6)), jnp.bfloat16)
+    vals = jnp.asarray(rng.normal(size=(16, 6)), jnp.bfloat16)
+    xv = jnp.asarray(rng.normal(size=(16, 6, 8)), jnp.bfloat16)
+
+    def loss(w, vals, xv):
+        return jnp.sum(pallas_fm.fused_fm(w, vals, xv, True))
+
+    dw, dvals, dxv = jax.grad(loss, argnums=(0, 1, 2))(w, vals, xv)
+    assert dw.dtype == jnp.bfloat16
+    assert dvals.dtype == jnp.bfloat16
+    assert dxv.dtype == jnp.bfloat16
+
+    def ref_loss(w, vals, xv):
+        return jnp.sum(pallas_fm.reference_fm(w, vals, xv))
+
+    rw, rvals, rxv = jax.grad(ref_loss, argnums=(0, 1, 2))(w, vals, xv)
+    np.testing.assert_allclose(np.asarray(dxv, np.float32),
+                               np.asarray(rxv, np.float32),
+                               rtol=0.05, atol=0.05)
+    np.testing.assert_allclose(np.asarray(dw, np.float32),
+                               np.asarray(rw, np.float32),
+                               rtol=0.05, atol=0.05)
