@@ -1,0 +1,55 @@
+"""Filesystem seam: local paths plus remote object stores (``gs://`` etc.).
+
+The reference reads training data from S3 — either downloaded by SageMaker
+File mode or streamed through the Pipe-mode FIFO (X3). The TPU-native
+equivalent streams from GCS: every byte-level reader in this package opens
+files through :func:`open_stream` and lists them through :func:`glob`, which
+dispatch to ``tf.io.gfile`` for URL-style paths (``gs://``, ``s3://``,
+``hdfs://`` — whatever the installed TF build supports) and to plain POSIX
+I/O otherwise. TensorFlow is imported lazily and only for remote paths, so
+local training never pays the import.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import BinaryIO, List
+
+_gfile_mod = None
+
+
+def is_remote(path: str) -> bool:
+    return "://" in path
+
+
+def _gfile():
+    global _gfile_mod
+    if _gfile_mod is None:
+        try:
+            from tensorflow.io import gfile  # noqa: PLC0415 (lazy, heavy)
+        except ImportError as e:  # pragma: no cover - env without TF
+            raise RuntimeError(
+                "remote paths (gs:// etc.) require tensorflow's tf.io.gfile; "
+                "download the data locally or install tensorflow") from e
+        _gfile_mod = gfile
+    return _gfile_mod
+
+
+def open_stream(path: str, mode: str = "rb") -> BinaryIO:
+    """Open a (possibly remote) path for sequential reading."""
+    if is_remote(path):
+        return _gfile().GFile(path, mode)
+    return open(path, mode)
+
+
+def glob(pattern: str) -> List[str]:
+    if is_remote(pattern):
+        return sorted(_gfile().glob(pattern))
+    return sorted(_glob.glob(pattern))
+
+
+def isdir(path: str) -> bool:
+    if is_remote(path):
+        return _gfile().isdir(path)
+    return os.path.isdir(path)

@@ -25,7 +25,7 @@ from typing import BinaryIO, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from . import example_codec, sharding, tfrecord
+from . import example_codec, fileio, sharding, tfrecord
 
 Batch = Dict[str, np.ndarray]
 
@@ -105,8 +105,8 @@ def _iter_framed_stream(stream: BinaryIO, loader, verify_crc: bool = True
 
 def _iter_framed_chunks(path: str, loader, verify_crc: bool = True
                         ) -> Iterator[Tuple[bytes, np.ndarray, np.ndarray]]:
-    """File-path front-end of ``_iter_framed_stream``."""
-    with open(path, "rb") as f:
+    """File-path front-end of ``_iter_framed_stream`` (local or gs://)."""
+    with fileio.open_stream(path, "rb") as f:
         yield from _iter_framed_stream(f, loader, verify_crc)
 
 
@@ -404,7 +404,7 @@ class ChainedFileStream:
             if self._fh is None:
                 if self._idx >= len(self._files):
                     break
-                self._fh = open(self._files[self._idx], "rb")
+                self._fh = fileio.open_stream(self._files[self._idx], "rb")
                 self._idx += 1
             chunk = self._fh.read(n - len(out))
             if not chunk:

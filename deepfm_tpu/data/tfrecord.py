@@ -141,7 +141,12 @@ def iter_records_from_stream(stream: BinaryIO, *, verify_crc: bool = True) -> It
 
 
 def iter_records(path: str, *, verify_crc: bool = True) -> Iterator[bytes]:
-    """Iterate records of a TFRecord file."""
+    """Iterate records of a TFRecord file (local or gs://)."""
+    from . import fileio  # noqa: PLC0415 (avoid import cycle at module load)
+    if fileio.is_remote(path):
+        with fileio.open_stream(path, "rb") as f:
+            yield from iter_records_from_stream(f, verify_crc=verify_crc)
+        return
     with open(path, "rb", buffering=1 << 20) as f:
         yield from iter_records_from_stream(f, verify_crc=verify_crc)
 

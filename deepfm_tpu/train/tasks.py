@@ -27,6 +27,7 @@ import jax
 import numpy as np
 
 from ..config import Config
+from ..data import fileio
 from ..data import pipeline as pipe_lib
 from ..data import sharding as shard_lib
 from ..parallel import bootstrap
@@ -39,12 +40,15 @@ from .state import TrainState
 
 
 def resolve_files(directory: str, prefix: str) -> List[str]:
-    """Glob `{prefix}*.tfrecords`; fall back to all *.tfrecords."""
+    """Glob `{prefix}*.tfrecords`; fall back to all *.tfrecords.
+    Supports local dirs and object-store URLs (gs://...)."""
     if not directory:
         return []
-    files = sorted(_glob.glob(os.path.join(directory, f"{prefix}*.tfrecords")))
+    sep = "/" if fileio.is_remote(directory) else os.sep
+    base = directory.rstrip(sep)
+    files = fileio.glob(f"{base}{sep}{prefix}*.tfrecords")
     if not files:
-        files = sorted(_glob.glob(os.path.join(directory, "*.tfrecords")))
+        files = fileio.glob(f"{base}{sep}*.tfrecords")
     return files
 
 
@@ -60,8 +64,11 @@ def _channel_path(cfg: Config, name: str, *, require: bool = False) -> str:
         c if c.isalnum() else "_" for c in name).upper()
     if os.environ.get(env_key):
         return os.environ[env_key]
-    sub = os.path.join(cfg.data_dir, name) if cfg.data_dir else ""
-    if sub and os.path.isdir(sub):
+    if cfg.data_dir and fileio.is_remote(cfg.data_dir):
+        sub = cfg.data_dir.rstrip("/") + "/" + name
+    else:
+        sub = os.path.join(cfg.data_dir, name) if cfg.data_dir else ""
+    if sub and fileio.isdir(sub):
         return sub
     if require:
         raise FileNotFoundError(
@@ -112,16 +119,37 @@ def _local_batch_size(cfg: Config) -> int:
     return cfg.batch_size // nproc
 
 
-def _shard_spec(cfg: Config, files: List[str]) -> shard_lib.ShardSpec:
+def _shard_spec(cfg: Config, files: List[str],
+                rank: Optional[int] = None) -> shard_lib.ShardSpec:
+    rank = jax.process_index() if rank is None else rank
     return shard_lib.shard_files(
         files,
         enable_data_multi_path=cfg.enable_data_multi_path,
         enable_s3_shard=cfg.enable_s3_shard,
-        rank=jax.process_index(),
-        local_rank=jax.process_index() % max(cfg.worker_per_host, 1),
+        rank=rank,
+        local_rank=rank % max(cfg.worker_per_host, 1),
         world_size=jax.process_count(),
         workers_per_host=cfg.worker_per_host,
     )
+
+
+def _validate_shard_coverage(cfg: Config, files: List[str]) -> None:
+    """Startup guard for multi-process jobs: the per-rank shard specs must
+    jointly cover every training file exactly once (the property the
+    README decision table guarantees). Pure policy computation — every rank
+    derives all ranks' specs and checks the same thing. Only meaningful
+    when all ranks see the same file list (not multi-path private dirs)."""
+    world = jax.process_count()
+    if world <= 1 or cfg.enable_data_multi_path:
+        return
+    if cfg.enable_s3_shard:
+        # Storage pre-sharded per host: this host's local workers must cover
+        # THIS host's file list (other hosts hold other files).
+        ranks = range(min(max(cfg.worker_per_host, 1), world))
+    else:
+        ranks = range(world)
+    specs = [_shard_spec(cfg, files, rank=r) for r in ranks]
+    shard_lib.validate_shard_coverage(specs, sorted(files))
 
 
 def make_pipeline(cfg: Config, files: List[str], *, epochs: int = 1,
@@ -284,6 +312,7 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
     va_files = resolve_files(eval_dir, "va")
     if not tr_files:
         raise FileNotFoundError(f"no training tfrecords in {train_dir!r}")
+    _validate_shard_coverage(cfg, tr_files)
     ulog.info(f"train dir={train_dir} files={len(tr_files)} "
               f"eval files={len(va_files)}")
 

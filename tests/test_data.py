@@ -380,3 +380,65 @@ class TestNativeStreaming:
         assert len(list(sp)) == 6
         with pytest.raises(RuntimeError):
             list(sp)
+
+
+class TestFileIO:
+    """Remote-path seam (the S3/GCS streaming analog, X3): URL-style paths
+    dispatch to tf.io.gfile, local paths to POSIX I/O."""
+
+    def test_local_paths_use_posix(self, tmp_path):
+        from deepfm_tpu.data import fileio
+        p = tmp_path / "x.tfrecords"
+        p.write_bytes(b"abc")
+        assert not fileio.is_remote(str(p))
+        with fileio.open_stream(str(p)) as f:
+            assert f.read() == b"abc"
+        assert fileio.glob(str(tmp_path / "*.tfrecords")) == [str(p)]
+        assert fileio.isdir(str(tmp_path))
+
+    def test_remote_paths_dispatch_to_gfile(self, monkeypatch):
+        from deepfm_tpu.data import fileio
+
+        calls = []
+
+        class FakeGFile:
+            def __init__(self, path, mode):
+                calls.append(("open", path, mode))
+
+        class FakeModule:
+            GFile = FakeGFile
+
+            @staticmethod
+            def glob(pattern):
+                calls.append(("glob", pattern))
+                return ["gs://b/tr2.tfrecords", "gs://b/tr1.tfrecords"]
+
+            @staticmethod
+            def isdir(path):
+                calls.append(("isdir", path))
+                return True
+
+        monkeypatch.setattr(fileio, "_gfile_mod", FakeModule)
+        assert fileio.is_remote("gs://b/data")
+        fileio.open_stream("gs://b/tr1.tfrecords")
+        assert fileio.glob("gs://b/*.tfrecords") == [
+            "gs://b/tr1.tfrecords", "gs://b/tr2.tfrecords"]  # sorted
+        assert fileio.isdir("gs://b/data")
+        assert [c[0] for c in calls] == ["open", "glob", "isdir"]
+
+    def test_resolve_files_remote_pattern(self, monkeypatch):
+        from deepfm_tpu.data import fileio
+        from deepfm_tpu.train import tasks
+
+        patterns = []
+
+        class FakeModule:
+            @staticmethod
+            def glob(pattern):
+                patterns.append(pattern)
+                return ["gs://b/criteo/tr1.tfrecords"]
+
+        monkeypatch.setattr(fileio, "_gfile_mod", FakeModule)
+        files = tasks.resolve_files("gs://b/criteo/", "tr")
+        assert files == ["gs://b/criteo/tr1.tfrecords"]
+        assert patterns == ["gs://b/criteo/tr*.tfrecords"]
