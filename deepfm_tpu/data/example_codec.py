@@ -1,12 +1,15 @@
 """Minimal ``tf.train.Example`` wire-format codec (no TensorFlow dependency).
 
 The reference keeps all data as TFRecord files of ``tf.train.Example`` protos
-with schema ``{label: float, feat_ids: int64[F], feat_vals: float[F]}``
-(written by ``tools/libsvm_to_tfrecord.py:25-33``, decoded vectorized at
-``1-ps-cpu/DeepFM-dist-ps-for-multipleCPU-multiInstance.py:81-86``). We keep
-TFRecord as the on-disk format for drop-in compatibility, but implement the
-codec ourselves: this module is the pure-Python reference implementation; the
-C++ fast path lives in ``deepfm_tpu/native/``.
+with **on-disk** schema ``{label: float, ids: int64[F], values: float[F]}``
+(written by ``tools/libsvm_to_tfrecord.py:25-33``, parsed with exactly those
+keys at ``1-ps-cpu/DeepFM-dist-ps-for-multipleCPU-multiInstance.py:81-86``;
+the parsed tensors are then *renamed* to ``feat_ids``/``feat_vals`` for the
+in-memory model_fn contract at ``:92``). We keep TFRecord as the on-disk
+format for drop-in compatibility, write the reference key set, and accept
+both key sets on read (``ids``/``values`` and the legacy repo aliases
+``feat_ids``/``feat_vals`` from pre-r3 files). This module is the pure-Python
+reference implementation; the C++ fast path lives in ``deepfm_tpu/native/``.
 
 Wire format facts used (protobuf encoding spec):
   Example        { Features features = 1; }
@@ -269,8 +272,14 @@ def decode_example(buf: bytes) -> Dict[str, Tuple[str, FeatureValue]]:
 # ---------------------------------------------------------------------------
 
 LABEL_KEY = "label"
-IDS_KEY = "feat_ids"
-VALS_KEY = "feat_vals"
+# On-disk keys as written by the reference converter
+# (tools/libsvm_to_tfrecord.py:25-33).
+IDS_KEY = "ids"
+VALS_KEY = "values"
+# Pre-r3 files from this repo used the in-memory feature names on disk;
+# still accepted on read.
+LEGACY_IDS_KEY = "feat_ids"
+LEGACY_VALS_KEY = "feat_vals"
 
 
 def encode_ctr_example(label: float, ids: np.ndarray, vals: np.ndarray) -> bytes:
@@ -283,11 +292,27 @@ def encode_ctr_example(label: float, ids: np.ndarray, vals: np.ndarray) -> bytes
 
 
 def decode_ctr_example(buf: bytes, field_size: int) -> Tuple[float, np.ndarray, np.ndarray]:
-    """Decode one CTR Example; validates fixed field_size (parse_example analog)."""
+    """Decode one CTR Example; validates fixed field_size (parse_example analog).
+
+    Accepts both the reference's on-disk keys (``ids``/``values``) and this
+    repo's legacy aliases (``feat_ids``/``feat_vals``).
+    """
     feats = decode_example(buf)
-    _, label = feats[LABEL_KEY]
-    _, ids = feats[IDS_KEY]
-    _, vals = feats[VALS_KEY]
+    try:
+        _, label = feats[LABEL_KEY]
+        if IDS_KEY in feats:
+            _, ids = feats[IDS_KEY]
+        else:
+            _, ids = feats[LEGACY_IDS_KEY]
+        if VALS_KEY in feats:
+            _, vals = feats[VALS_KEY]
+        else:
+            _, vals = feats[LEGACY_VALS_KEY]
+    except KeyError:
+        raise ValueError(
+            "Example is missing CTR schema keys: found "
+            f"{sorted(feats)}, need 'label' plus 'ids'/'values' "
+            "(reference schema) or 'feat_ids'/'feat_vals' (legacy)") from None
     ids = np.asarray(ids, np.int64)
     vals = np.asarray(vals, np.float32)
     if ids.shape[0] != field_size or vals.shape[0] != field_size:

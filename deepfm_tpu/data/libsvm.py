@@ -2,7 +2,7 @@
 
 Reference behavior (``tools/libsvm_to_tfrecord.py:5-37``): each input line
 ``"label id:val id:val ..."`` becomes one ``Example{label: float,
-feat_ids: int64[F], feat_vals: float[F]}``. This implementation adds what the
+ids: int64[F], values: float[F]}``. This implementation adds what the
 reference's converter lacks: sharded output, field-size validation, a reverse
 (TFRecord->LibSVM) path for round-trip testing, and a synthetic-data
 generator for tests/benchmarks.

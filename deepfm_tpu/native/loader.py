@@ -79,6 +79,12 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_long), ctypes.c_long, ctypes.c_long,
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_float)]
+        lib.dfm_decode_ctr_ex.restype = ctypes.c_long
+        lib.dfm_decode_ctr_ex.argtypes = [
+            ctypes.POINTER(ctypes.c_ubyte), ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_long), ctypes.c_long, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_long)]
         lib.dfm_crc32c.restype = ctypes.c_uint32
         lib.dfm_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_long]
         _lib = lib
@@ -159,19 +165,28 @@ def decode_spans(buf, offsets: np.ndarray, lengths: np.ndarray,
     vals = np.empty((n, field_size), dtype=np.float32)
     offsets = np.ascontiguousarray(offsets, dtype=np.int64)
     lengths = np.ascontiguousarray(lengths, dtype=np.int64)
-    rc = lib.dfm_decode_ctr(
+    detail = ctypes.c_long(0)
+    rc = lib.dfm_decode_ctr_ex(
         _as_ubyte_ptr(buf),
         offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
         lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
         n, field_size,
         labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.byref(detail))
     if rc != 0:
         bad = -rc - 100
-        raise ValueError(
-            f"native decode failed at record {bad} "
-            f"(schema/field_size mismatch, expected field_size={field_size})")
+        reasons = {
+            -20: "'label' is not a single float",
+            -21: f"'ids' length != field_size={field_size}",
+            -22: f"'values' length != field_size={field_size}",
+            -23: ("required keys missing — need 'label' plus 'ids'/'values' "
+                  "(reference schema) or 'feat_ids'/'feat_vals' (legacy)"),
+        }
+        reason = reasons.get(detail.value,
+                             f"malformed Example wire data (code {detail.value})")
+        raise ValueError(f"native decode failed at record {bad}: {reason}")
     return labels, ids, vals
 
 

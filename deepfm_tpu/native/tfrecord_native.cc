@@ -11,8 +11,10 @@
 //
 // Build: g++ -O3 -march=native -shared -fPIC tfrecord_native.cc -o libtfrecord.so
 //
-// Schema (tools/libsvm_to_tfrecord.py analog):
-//   Example{ label: float_list[1], feat_ids: int64_list[F], feat_vals: float_list[F] }
+// On-disk schema, matching the reference converter (tools/libsvm_to_tfrecord.py:25-33):
+//   Example{ label: float_list[1], ids: int64_list[F], values: float_list[F] }
+// The legacy aliases feat_ids/feat_vals (written by pre-r3 versions of this
+// repo) are accepted on read.
 
 #include <cstdint>
 #include <cstring>
@@ -231,11 +233,13 @@ long parse_ctr_example(const uint8_t* p, const uint8_t* end, long field_size,
       if (key_is(key, "label") && vfield == 2) {
         if (parse_float_list(payload, pend, label, 1) != 1) return -20;
         got_label = true;
-      } else if (key_is(key, "feat_ids") && vfield == 3) {
+      } else if ((key_is(key, "ids") || key_is(key, "feat_ids")) &&
+                 vfield == 3) {
         if (parse_int64_list(payload, pend, ids, field_size) != field_size)
           return -21;
         got_ids = true;
-      } else if (key_is(key, "feat_vals") && vfield == 2) {
+      } else if ((key_is(key, "values") || key_is(key, "feat_vals")) &&
+                 vfield == 2) {
         if (parse_float_list(payload, pend, vals, field_size) != field_size)
           return -22;
         got_vals = true;
@@ -308,18 +312,31 @@ long dfm_split_frames(const uint8_t* buf, long len, long verify_crc,
 
 // Decode n CTR Examples addressed by (offsets, lengths) into fixed-shape
 // outputs: labels[n], ids[n*field_size], vals[n*field_size].
-// Returns 0, or -(100+i) error at record i (error detail lost by design —
-// the Python fallback re-decodes for the message).
-long dfm_decode_ctr(const uint8_t* buf, const long* offsets, const long* lengths,
-                    long n, long field_size, float* labels, int32_t* ids,
-                    float* vals) {
+// Returns 0, or -(100+i) error at record i; *err_detail (if non-null) holds
+// the parse_ctr_example code for that record: -10..-13 malformed wire,
+// -20/-21/-22 label/ids/values length != expected, -23 required key missing.
+long dfm_decode_ctr_ex(const uint8_t* buf, const long* offsets,
+                       const long* lengths, long n, long field_size,
+                       float* labels, int32_t* ids, float* vals,
+                       long* err_detail) {
   for (long i = 0; i < n; ++i) {
     const uint8_t* p = buf + offsets[i];
     long rc = parse_ctr_example(p, p + lengths[i], field_size, labels + i,
                                 ids + i * field_size, vals + i * field_size);
-    if (rc != 0) return -(100 + i);
+    if (rc != 0) {
+      if (err_detail) *err_detail = rc;
+      return -(100 + i);
+    }
   }
   return 0;
+}
+
+// Back-compat entry without the error-detail out-param.
+long dfm_decode_ctr(const uint8_t* buf, const long* offsets, const long* lengths,
+                    long n, long field_size, float* labels, int32_t* ids,
+                    float* vals) {
+  return dfm_decode_ctr_ex(buf, offsets, lengths, n, field_size, labels, ids,
+                           vals, nullptr);
 }
 
 // Standalone CRC32C for tests.
