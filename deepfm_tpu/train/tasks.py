@@ -44,11 +44,9 @@ def resolve_files(directory: str, prefix: str) -> List[str]:
     Supports local dirs and object-store URLs (gs://...)."""
     if not directory:
         return []
-    sep = "/" if fileio.is_remote(directory) else os.sep
-    base = directory.rstrip(sep)
-    files = fileio.glob(f"{base}{sep}{prefix}*.tfrecords")
+    files = fileio.glob(fileio.join(directory, f"{prefix}*.tfrecords"))
     if not files:
-        files = fileio.glob(f"{base}{sep}*.tfrecords")
+        files = fileio.glob(fileio.join(directory, "*.tfrecords"))
     return files
 
 
@@ -64,10 +62,7 @@ def _channel_path(cfg: Config, name: str, *, require: bool = False) -> str:
         c if c.isalnum() else "_" for c in name).upper()
     if os.environ.get(env_key):
         return os.environ[env_key]
-    if cfg.data_dir and fileio.is_remote(cfg.data_dir):
-        sub = cfg.data_dir.rstrip("/") + "/" + name
-    else:
-        sub = os.path.join(cfg.data_dir, name) if cfg.data_dir else ""
+    sub = fileio.join(cfg.data_dir, name) if cfg.data_dir else ""
     if sub and fileio.isdir(sub):
         return sub
     if require:
@@ -215,7 +210,7 @@ def _restore_or_init(trainer: Trainer, cfg: Config, require: bool,
             raise FileNotFoundError(
                 f"task '{cfg.task_type}' requires model_dir")
         return state
-    if require and not os.path.isdir(cfg.model_dir):
+    if require and not fileio.isdir(cfg.model_dir):
         raise FileNotFoundError(
             f"task '{cfg.task_type}' needs a checkpoint in model_dir="
             f"{cfg.model_dir!r}")
@@ -411,7 +406,7 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
             mgr.close()
 
     if cfg.servable_model_dir and bootstrap.is_chief():
-        out = os.path.join(cfg.servable_model_dir, str(int(state.step)))
+        out = fileio.join(cfg.servable_model_dir, str(int(state.step)))
         export_lib.export_serving(trainer.model, state, cfg, out)
     result["steps"] = float(int(state.step))
     return result
@@ -522,9 +517,9 @@ def _task_infer(trainer: Trainer, cfg: Config) -> Dict[str, float]:
     else:
         all_probs = local
 
-    out_path = os.path.join(cfg.val_data_dir or cfg.data_dir, "pred.txt")
+    out_path = fileio.join(cfg.val_data_dir or cfg.data_dir, "pred.txt")
     if bootstrap.is_chief():
-        with open(out_path, "w") as f:
+        with fileio.open_stream(out_path, "w") as f:
             for p in all_probs:
                 f.write(f"{float(p):.6f}\n")  # one prob per line (ref :447-449)
         ulog.info(f"wrote {len(all_probs)} predictions to {out_path}")
@@ -536,6 +531,6 @@ def _task_export(trainer: Trainer, cfg: Config) -> Dict[str, float]:
         raise ValueError("export task requires servable_model_dir")
     state = _restore_or_init(trainer, cfg, require=True)
     if bootstrap.is_chief():
-        out = os.path.join(cfg.servable_model_dir, str(int(state.step)))
+        out = fileio.join(cfg.servable_model_dir, str(int(state.step)))
         export_lib.export_serving(trainer.model, state, cfg, out)
     return {"step": float(int(state.step))}

@@ -12,12 +12,12 @@ training steps), and keeps ``max_to_keep`` checkpoints. Preemption tolerance
 
 from __future__ import annotations
 
-import os
 from typing import Any, Optional
 
 import jax
 import orbax.checkpoint as ocp
 
+from ..data import fileio
 from . import logging as ulog
 
 
@@ -26,8 +26,8 @@ class CheckpointManager:
 
     def __init__(self, directory: str, *, max_to_keep: int = 3,
                  save_interval_steps: int = 0, async_save: bool = True):
-        self._dir = os.path.abspath(directory)
-        os.makedirs(self._dir, exist_ok=True)
+        self._dir = fileio.normalize_dir(directory)
+        fileio.makedirs(self._dir)
         options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep,
             enable_async_checkpointing=async_save,
@@ -35,6 +35,7 @@ class CheckpointManager:
         self._mgr = ocp.CheckpointManager(self._dir, options=options)
         self.save_interval_steps = save_interval_steps
         self._last_should_save_step: Optional[int] = None
+        self._saved_steps: set = set()
 
     @property
     def directory(self) -> str:
@@ -44,10 +45,14 @@ class CheckpointManager:
         return self._mgr.latest_step()
 
     def save(self, step: int, state: Any, *, force: bool = False) -> bool:
-        if step in self._mgr.all_steps():
+        # Dedup against steps saved THIS session too: async saves may not yet
+        # appear in all_steps() when the final forced save lands on the same
+        # step as an in-flight interval save.
+        if step in self._saved_steps or step in self._mgr.all_steps():
             return False  # e.g. final forced save after an interval save hit it
         saved = self._mgr.save(step, args=ocp.args.StandardSave(state), force=force)
         if saved:
+            self._saved_steps.add(step)
             ulog.info(f"checkpoint saved at step {step} -> {self._dir}")
         return saved
 
@@ -99,9 +104,8 @@ def _as_abstract(x: Any) -> Any:
 def clear_model_dir(directory: str) -> None:
     """clear_existing_model semantics (reference 2-hvd-gpu/...py:60,334-340):
     wipe the checkpoint dir for a fresh run; chief only."""
-    import shutil
     if jax.process_index() != 0:
         return
-    if os.path.isdir(directory):
-        shutil.rmtree(directory)
+    if fileio.isdir(directory):
+        fileio.rmtree(directory)
         ulog.info(f"cleared existing model dir {directory}")

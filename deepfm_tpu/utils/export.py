@@ -17,7 +17,6 @@ round-trip analog used by tests and the infer benchmark.
 from __future__ import annotations
 
 import json
-import os
 from typing import Any, Callable, Dict
 
 import jax
@@ -27,6 +26,7 @@ import orbax.checkpoint as ocp
 from jax import export as jax_export
 
 from ..config import Config
+from ..data import fileio
 from . import logging as ulog
 
 _SERVING_FILE = "serving_fn.stablehlo"
@@ -51,14 +51,14 @@ def export_serving(model, state, cfg: Config, out_dir: str) -> str:
     ``2-hvd-gpu/...py:429-431``). Params are fetched to host and saved
     unsharded so any single-device server can load them.
     """
-    os.makedirs(out_dir, exist_ok=True)
+    fileio.makedirs(out_dir)
 
     # 1. Params (device-gathered, unsharded).
     params = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), state.params)
     model_state = jax.tree.map(
         lambda x: np.asarray(jax.device_get(x)), state.model_state)
     ckptr = ocp.StandardCheckpointer()
-    params_path = os.path.join(os.path.abspath(out_dir), _PARAMS_DIR)
+    params_path = fileio.join(fileio.normalize_dir(out_dir), _PARAMS_DIR)
     ckptr.save(params_path, {"params": params, "model_state": model_state},
                force=True)
     ckptr.wait_until_finished()
@@ -76,7 +76,7 @@ def export_serving(model, state, cfg: Config, out_dir: str) -> str:
         exported = jax_export.export(
             jax.jit(serve), platforms=("cpu", "tpu"))(
                 params_spec, mstate_spec, ids_spec, vals_spec)
-        with open(os.path.join(out_dir, _SERVING_FILE), "wb") as f:
+        with fileio.open_stream(fileio.join(out_dir, _SERVING_FILE), "wb") as f:
             f.write(exported.serialize())
     except Exception as e:  # pragma: no cover - platform-specific lowering
         ulog.warning(f"stablehlo export skipped ({e}); params-only artifact")
@@ -94,7 +94,7 @@ def export_serving(model, state, cfg: Config, out_dir: str) -> str:
         "config": cfg.to_dict(),
         "step": int(jax.device_get(state.step)),
     }
-    with open(os.path.join(out_dir, _CONFIG_FILE), "w") as f:
+    with fileio.open_stream(fileio.join(out_dir, _CONFIG_FILE), "w") as f:
         json.dump(meta, f, indent=2)
     ulog.info(f"exported servable model to {out_dir}")
     return out_dir
@@ -102,16 +102,17 @@ def export_serving(model, state, cfg: Config, out_dir: str) -> str:
 
 def load_serving(artifact_dir: str) -> Callable[[np.ndarray, np.ndarray], np.ndarray]:
     """Reload a servable artifact as ``f(feat_ids, feat_vals) -> probs``."""
-    with open(os.path.join(artifact_dir, _CONFIG_FILE)) as f:
+    with fileio.open_stream(fileio.join(artifact_dir, _CONFIG_FILE), "r") as f:
         meta = json.load(f)
     cfg = Config.from_dict(meta["config"])
     ckptr = ocp.StandardCheckpointer()
-    restored = ckptr.restore(os.path.join(os.path.abspath(artifact_dir), _PARAMS_DIR))
+    restored = ckptr.restore(
+        fileio.join(fileio.normalize_dir(artifact_dir), _PARAMS_DIR))
     params, model_state = restored["params"], restored["model_state"]
 
-    hlo_path = os.path.join(artifact_dir, _SERVING_FILE)
-    if os.path.exists(hlo_path):
-        with open(hlo_path, "rb") as f:
+    hlo_path = fileio.join(artifact_dir, _SERVING_FILE)
+    if fileio.exists(hlo_path):
+        with fileio.open_stream(hlo_path, "rb") as f:
             exported = jax_export.deserialize(f.read())
 
         def serve(feat_ids: np.ndarray, feat_vals: np.ndarray) -> np.ndarray:

@@ -1,0 +1,214 @@
+"""Remote-storage seam tests: checkpoint/export/pred paths must route every
+filesystem touch through ``data/fileio`` so a ``gs://`` model_dir works the
+way the reference's shared-storage S3 model_dir does (``README-EN.md:62``,
+``1-ps-cpu/...py:434``). A fake ``mock://`` scheme backed by a local directory
+stands in for GCS: if any code path bypasses the seam, either the raw URI
+leaks to POSIX (creating a literal ``mock:`` directory) or the fake store
+never sees the file — both asserted here.
+"""
+
+import glob as _glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deepfm_tpu.config import Config
+from deepfm_tpu.data import fileio, libsvm
+from deepfm_tpu.train import Trainer, tasks
+from deepfm_tpu.utils import checkpoint as ckpt_lib
+from deepfm_tpu.utils import export as export_lib
+
+
+class FakeGfile:
+    """tf.io.gfile stand-in: any ``scheme://rest`` path maps into a local
+    backing root. Records calls so tests can assert the seam was used."""
+
+    def __init__(self, root: str):
+        self.root = str(root)
+        self.calls = []
+
+    def _local(self, path: str) -> str:
+        assert "://" in path, f"FakeGfile got a non-remote path: {path!r}"
+        rest = path.split("://", 1)[1]
+        return os.path.join(self.root, *rest.split("/"))
+
+    def GFile(self, path, mode="r"):
+        self.calls.append(("GFile", path, mode))
+        local = self._local(path)
+        if "w" in mode or "a" in mode:
+            os.makedirs(os.path.dirname(local), exist_ok=True)
+        return open(local, mode)
+
+    def glob(self, pattern):
+        self.calls.append(("glob", pattern))
+        scheme = pattern.split("://", 1)[0]
+        out = []
+        for p in _glob.glob(self._local(pattern)):
+            rel = os.path.relpath(p, self.root).replace(os.sep, "/")
+            out.append(f"{scheme}://{rel}")
+        return out
+
+    def isdir(self, path):
+        self.calls.append(("isdir", path))
+        return os.path.isdir(self._local(path))
+
+    def exists(self, path):
+        self.calls.append(("exists", path))
+        return os.path.exists(self._local(path))
+
+    def makedirs(self, path):
+        self.calls.append(("makedirs", path))
+        os.makedirs(self._local(path), exist_ok=True)
+
+    def rmtree(self, path):
+        self.calls.append(("rmtree", path))
+        import shutil
+        shutil.rmtree(self._local(path))
+
+
+@pytest.fixture
+def fake_store(tmp_path, monkeypatch):
+    fake = FakeGfile(str(tmp_path / "store"))
+    os.makedirs(fake.root, exist_ok=True)
+    monkeypatch.setattr(fileio, "_gfile_mod", fake)
+    yield fake
+    # The raw URI leaking into POSIX would have created a literal 'mock:'
+    # entry under cwd or tmp_path; assert neither exists.
+    assert not os.path.exists("mock:"), "raw remote URI hit POSIX open/mkdir"
+    assert not (tmp_path / "mock:").exists()
+
+
+class TestFileioHelpers:
+    def test_normalize_dir_keeps_remote_uri(self):
+        assert fileio.normalize_dir("mock://b/ckpt/") == "mock://b/ckpt"
+        assert fileio.normalize_dir("gs://b/x") == "gs://b/x"
+        local = fileio.normalize_dir("relative/dir")
+        assert os.path.isabs(local)
+
+    def test_join(self):
+        assert fileio.join("mock://b/data", "pred.txt") == "mock://b/data/pred.txt"
+        assert fileio.join("mock://b/", "sub", "5") == "mock://b/sub/5"
+        assert fileio.join("/tmp/x", "y") == os.path.join("/tmp/x", "y")
+
+    def test_open_stream_roundtrip(self, fake_store):
+        with fileio.open_stream("mock://b/dir/f.txt", "w") as f:
+            f.write("hello")
+        assert fileio.exists("mock://b/dir/f.txt")
+        with fileio.open_stream("mock://b/dir/f.txt", "r") as f:
+            assert f.read() == "hello"
+        assert ("GFile", "mock://b/dir/f.txt", "w") in fake_store.calls
+
+    def test_dir_ops(self, fake_store):
+        fileio.makedirs("mock://b/d1/d2")
+        assert fileio.isdir("mock://b/d1/d2")
+        with fileio.open_stream("mock://b/d1/d2/a.tfrecords", "wb") as f:
+            f.write(b"x")
+        assert fileio.glob("mock://b/d1/d2/*.tfrecords") == [
+            "mock://b/d1/d2/a.tfrecords"]
+        fileio.rmtree("mock://b/d1")
+        assert not fileio.exists("mock://b/d1")
+
+
+class TestCheckpointRemoteSeam:
+    def test_manager_does_not_mangle_remote_dir(self, fake_store, monkeypatch):
+        captured = {}
+
+        class StubMgr:
+            def __init__(self, directory, options=None):
+                captured["dir"] = str(directory)
+
+            def latest_step(self):
+                return None
+
+        monkeypatch.setattr(ckpt_lib.ocp, "CheckpointManager", StubMgr)
+        mgr = ckpt_lib.CheckpointManager("mock://bucket/run1/ckpt")
+        # Orbax receives the URI verbatim — not /cwd/mock:/bucket/...
+        assert captured["dir"] == "mock://bucket/run1/ckpt"
+        assert mgr.directory == "mock://bucket/run1/ckpt"
+        # and the dir was created through the gfile seam
+        assert ("makedirs", "mock://bucket/run1/ckpt") in fake_store.calls
+
+    def test_clear_model_dir_remote(self, fake_store):
+        fileio.makedirs("mock://bucket/old_ckpt")
+        ckpt_lib.clear_model_dir("mock://bucket/old_ckpt")
+        assert not fileio.exists("mock://bucket/old_ckpt")
+        assert ("rmtree", "mock://bucket/old_ckpt") in fake_store.calls
+
+    def test_forced_save_dedups_in_flight_async_step(self, tmp_path):
+        """ADVICE r2: with async saves, all_steps() may not list a step whose
+        save is still in flight; the final forced save on the same step must
+        still dedup (session-local tracking)."""
+        mgr = ckpt_lib.CheckpointManager(str(tmp_path / "c"), async_save=True)
+        try:
+            state = {"w": np.zeros(4)}
+            assert mgr.save(7, state) is True
+            # No wait_until_finished: save may still be in flight.
+            assert mgr.save(7, state, force=True) is False
+        finally:
+            mgr.close()
+
+
+def _tiny_cfg(**kw):
+    base = dict(
+        feature_size=64, field_size=5, embedding_size=4, deep_layers="8",
+        dropout="1.0", batch_size=32, compute_dtype="float32",
+        mesh_data=1, log_steps=0, scale_lr_by_world=False, seed=11)
+    base.update(kw)
+    return Config(**base)
+
+
+class TestExportRemoteSeam:
+    def test_export_serving_remote_dir(self, fake_store, monkeypatch):
+        captured = {}
+
+        class StubCkptr:
+            def save(self, path, tree, force=False):
+                captured["params_path"] = str(path)
+
+            def wait_until_finished(self):
+                pass
+
+        monkeypatch.setattr(export_lib.ocp, "StandardCheckpointer",
+                            StubCkptr)
+        cfg = _tiny_cfg()
+        trainer = Trainer(cfg)
+        state = trainer.init_state()
+        out = export_lib.export_serving(
+            trainer.model, state, cfg, "mock://bucket/servable/5")
+        assert out == "mock://bucket/servable/5"
+        assert captured["params_path"] == "mock://bucket/servable/5/params.ckpt"
+        # config (and stablehlo when lowering succeeds) written via the seam
+        meta_local = os.path.join(
+            fake_store.root, "bucket", "servable", "5", "model_config.json")
+        meta = json.load(open(meta_local))
+        assert meta["signature"]["inputs"]["feat_ids"] == ["batch", 5, "int32"]
+
+
+class TestInferRemoteSeam:
+    def test_infer_reads_and_writes_remote(self, fake_store, tmp_path):
+        """End-to-end: te*.tfrecords live in the (fake) object store, the
+        checkpoint is local, pred.txt lands back in the store — the ADVICE r2
+        medium finding (infer against gs:// data crashed at the write)."""
+        data_remote_local = os.path.join(fake_store.root, "bucket", "data")
+        libsvm.generate_synthetic_ctr(
+            data_remote_local, num_files=1, examples_per_file=96,
+            feature_size=64, field_size=5, prefix="te", seed=12)
+        tr_dir = tmp_path / "tr"
+        libsvm.generate_synthetic_ctr(
+            str(tr_dir), num_files=1, examples_per_file=64,
+            feature_size=64, field_size=5, prefix="tr", seed=13)
+        ckpt_dir = str(tmp_path / "ckpt")
+        tasks.run(_tiny_cfg(task_type="train", num_epochs=1,
+                            data_dir=str(tr_dir), model_dir=ckpt_dir))
+
+        out = tasks.run(_tiny_cfg(
+            task_type="infer", data_dir=str(tr_dir),
+            val_data_dir="mock://bucket/data", model_dir=ckpt_dir))
+        assert out["num_predictions"] == 96
+        pred_local = os.path.join(data_remote_local, "pred.txt")
+        probs = [float(x) for x in open(pred_local).read().split()]
+        assert len(probs) == 96
+        assert all(0.0 <= p <= 1.0 for p in probs)
+        assert ("GFile", "mock://bucket/data/pred.txt", "w") in fake_store.calls
