@@ -149,9 +149,11 @@ def _validate_shard_coverage(cfg: Config, files: List[str]) -> None:
 
 def make_pipeline(cfg: Config, files: List[str], *, epochs: int = 1,
                   shuffle: bool = True, sharded: bool = True,
-                  drop_remainder: Optional[bool] = None) -> pipe_lib.CtrPipeline:
+                  drop_remainder: Optional[bool] = None,
+                  epoch_offset: int = 0) -> pipe_lib.CtrPipeline:
     return pipe_lib.CtrPipeline(
         files,
+        epoch_offset=epoch_offset,
         field_size=cfg.field_size,
         batch_size=_local_batch_size(cfg),
         num_epochs=epochs,
@@ -375,9 +377,11 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
             else:
                 for epoch in range(cfg.num_epochs):
                     # Per-epoch loop in the driver, per the reference's
-                    # file-mode shape (``2-hvd-gpu/...py:390-394``).
+                    # file-mode shape (``2-hvd-gpu/...py:390-394``). The
+                    # epoch index feeds the shuffle seed so each epoch sees
+                    # a fresh order (tf.data reshuffle_each_iteration analog).
                     pipeline = make_pipeline(cfg, tr_files, epochs=1,
-                                             shuffle=True)
+                                             shuffle=True, epoch_offset=epoch)
                     state, fit_m = trainer.fit(state, pipeline, hooks=hooks)
                     result["loss"] = fit_m["loss"]
                     result["examples_per_sec"] = fit_m.get(

@@ -72,14 +72,20 @@ def export_serving(model, state, cfg: Config, out_dir: str) -> str:
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params)
     mstate_spec = jax.tree.map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), model_state)
+    serialized = None
     try:
         exported = jax_export.export(
             jax.jit(serve), platforms=("cpu", "tpu"))(
                 params_spec, mstate_spec, ids_spec, vals_spec)
-        with fileio.open_stream(fileio.join(out_dir, _SERVING_FILE), "wb") as f:
-            f.write(exported.serialize())
+        serialized = exported.serialize()
     except Exception as e:  # pragma: no cover - platform-specific lowering
         ulog.warning(f"stablehlo export skipped ({e}); params-only artifact")
+    if serialized is not None:
+        # Outside the guard: an I/O failure here is a real error (retryable
+        # store hiccup, bad permissions), not a lowering limitation, and must
+        # surface instead of silently degrading to a params-only artifact.
+        with fileio.open_stream(fileio.join(out_dir, _SERVING_FILE), "wb") as f:
+            f.write(serialized)
 
     # 3. Signature/config metadata.
     meta = {
