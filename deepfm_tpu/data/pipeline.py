@@ -546,24 +546,49 @@ class StreamingCtrPipeline:
 
 
 def _prefetch(it: Iterator[Batch], depth: int) -> Iterator[Batch]:
-    """Run ``it`` in a daemon thread, keeping up to ``depth`` items ready."""
+    """Run ``it`` in a daemon thread, keeping up to ``depth`` items ready.
+
+    Consumer-abandonment-safe: if the consumer stops iterating early (e.g.
+    ragged-shard min-truncation drops a rank's tail mid-epoch), closing this
+    generator sets a stop flag; the producer's bounded put polls it, drops
+    out, and closes the source iterator — no permanently-blocked thread, no
+    leaked file handle."""
     q: "queue.Queue" = queue.Queue(maxsize=depth)
     _END = object()
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def worker() -> None:
         try:
             for item in it:
-                q.put(item)
-            q.put(_END)
+                if not _put(item):
+                    return
+            _put(_END)
         except BaseException as e:  # propagate into consumer
-            q.put(e)
+            _put(e)
+        finally:
+            if stop.is_set():
+                close = getattr(it, "close", None)
+                if close is not None:
+                    close()
 
     t = threading.Thread(target=worker, daemon=True)
     t.start()
-    while True:
-        item = q.get()
-        if item is _END:
-            return
-        if isinstance(item, BaseException):
-            raise item
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()

@@ -35,7 +35,7 @@ from ..utils import checkpoint as ckpt_lib
 from ..utils import export as export_lib
 from ..utils import logging as ulog
 from ..utils import profiling as prof_lib
-from .loop import Trainer
+from .loop import Trainer, pad_batch
 from .state import TrainState
 
 
@@ -170,6 +170,13 @@ def make_pipeline(cfg: Config, files: List[str], *, epochs: int = 1,
     )
 
 
+def _eval_pipeline(cfg: Config, va_files: List[str]) -> pipe_lib.CtrPipeline:
+    """Eval reads every record: no shuffle, keep the tail batch — the
+    weighted eval step pads it to the compiled shape with zero-weight rows,
+    so drop_remainder would only lose data, never save a recompile."""
+    return make_pipeline(cfg, va_files, shuffle=False, drop_remainder=False)
+
+
 def make_streaming_pipeline(cfg: Config, files: List[str], *, epochs: int = 1
                             ) -> pipe_lib.StreamingCtrPipeline:
     """Pipe-mode analog (``--pipe_mode 1``): one sequential single-pass
@@ -269,7 +276,12 @@ def _eval_check_due(n_dispatch: int) -> bool:
 def _make_throttled_eval_hook(trainer: Trainer, cfg: Config,
                               va_files: List[str], result: Dict[str, float]):
     """Mid-train eval hook with TrainSpec/EvalSpec timing semantics
-    (start_delay_secs / throttle_secs, reference 1-ps-cpu/...py:440-441)."""
+    (start_delay_secs / throttle_secs, reference 1-ps-cpu/...py:440-441).
+
+    Multi-process safety: dispatch counts are identical across ranks because
+    ``Trainer.fit`` min-truncates ragged shards (``_sync_truncate``), so every
+    rank reaches each agreed check dispatch — the chief's clock verdict is
+    then broadcast and the eval collective entered (or skipped) in lockstep."""
     import time as _time
 
     t_start = _time.time()
@@ -294,7 +306,7 @@ def _make_throttled_eval_hook(trainer: Trainer, cfg: Config,
             return
         last_eval_t[0] = _time.time()
         ev = trainer.evaluate(
-            state, make_pipeline(cfg, va_files, shuffle=False))
+            state, _eval_pipeline(cfg, va_files))
         result["mid_train_evals"] += 1
         result.update({"auc": ev["auc"], "eval_loss": ev["loss"]})
         ulog.info(f"throttled eval @ step {int(state.step)}: "
@@ -370,7 +382,7 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                 result["examples_per_sec"] = fit_m.get("examples_per_sec", 0.0)
                 if va_files:
                     ev = trainer.evaluate(
-                        state, make_pipeline(cfg, va_files, shuffle=False))
+                        state, _eval_pipeline(cfg, va_files))
                     ulog.info(f"streaming train done: eval auc={ev['auc']:.5f} "
                               f"loss={ev['loss']:.5f}")
                     result.update({"auc": ev["auc"], "eval_loss": ev["loss"]})
@@ -388,7 +400,7 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                         "examples_per_sec", 0.0)
                     if va_files and not eval_throttled:
                         ev = trainer.evaluate(
-                            state, make_pipeline(cfg, va_files, shuffle=False))
+                            state, _eval_pipeline(cfg, va_files))
                         ulog.info(
                             f"epoch {epoch + 1}/{cfg.num_epochs}: eval auc="
                             f"{ev['auc']:.5f} loss={ev['loss']:.5f}")
@@ -397,7 +409,7 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                 if va_files and eval_throttled:
                     # Final eval at completion (train_and_evaluate does one).
                     ev = trainer.evaluate(
-                        state, make_pipeline(cfg, va_files, shuffle=False))
+                        state, _eval_pipeline(cfg, va_files))
                     ulog.info(f"final eval: auc={ev['auc']:.5f} "
                               f"loss={ev['loss']:.5f}")
                     result.update({"auc": ev["auc"], "eval_loss": ev["loss"]})
@@ -422,18 +434,9 @@ def _task_eval(trainer: Trainer, cfg: Config) -> Dict[str, float]:
     if not va_files:
         raise FileNotFoundError("no eval tfrecords found")
     state = _restore_or_init(trainer, cfg, require=True)
-    ev = trainer.evaluate(state, make_pipeline(cfg, va_files, shuffle=False))
+    ev = trainer.evaluate(state, _eval_pipeline(cfg, va_files))
     ulog.info(f"eval: auc={ev['auc']:.5f} loss={ev['loss']:.5f}")
     return ev
-
-
-def _pad_batch(batch: Dict[str, np.ndarray], bs: int) -> Dict[str, np.ndarray]:
-    """Pad a short tail batch up to the compiled shape by repeating the last
-    row (predictions for the padding are trimmed by the caller)."""
-    n = batch["label"].shape[0]
-    pad = bs - n
-    return {k: np.concatenate([v, np.tile(v[-1:], (pad,) + (1,) * (v.ndim - 1))])
-            for k, v in batch.items()}
 
 
 def _interleave_rank_shards(gathered: np.ndarray, counts: np.ndarray
@@ -473,30 +476,23 @@ def _task_infer(trainer: Trainer, cfg: Config) -> Dict[str, float]:
 
     # Collectives inside predict_step require every process to run the same
     # number of rounds, but per-rank record counts can differ by one. Rather
-    # than a full counting pre-pass over the data (2x I/O), each round all
-    # ranks exchange their batch fill; a rank whose pipeline is exhausted
-    # feeds a dummy batch until every rank is done.
+    # than a full counting pre-pass over the data (2x I/O), ranks advance in
+    # lockstep rounds (Trainer.lockstep_batches — the same mechanism eval
+    # uses); an exhausted rank feeds dummy batches whose output is discarded.
     probs: List[np.ndarray] = []
     n_local = 0
-    it = iter(pipeline)
     if world > 1:
         from jax.experimental import multihost_utils  # noqa: PLC0415
-        dummy = {
-            "feat_ids": np.zeros((local_bs, cfg.field_size), np.int32),
-            "feat_vals": np.zeros((local_bs, cfg.field_size), np.float32),
-            "label": np.zeros((local_bs, 1), np.float32),
-        }
-        while True:
-            batch = next(it, None)
-            n = batch["label"].shape[0] if batch is not None else 0
-            fills = np.asarray(multihost_utils.process_allgather(
-                np.asarray([n])))
-            if int(fills.sum()) == 0:
-                break  # every rank exhausted
-            if batch is None:
-                batch = dummy
-            elif n < local_bs:
-                batch = _pad_batch(batch, local_bs)
+
+        from .loop import zero_batch  # noqa: PLC0415
+
+        def make_dummy():
+            return zero_batch(cfg.field_size, local_bs)
+
+        for batch, real in trainer.lockstep_batches(pipeline, make_dummy):
+            n = batch["label"].shape[0] if real else 0
+            if real and n < local_bs:
+                batch = pad_batch(batch, local_bs)
             p = next(iter(trainer.predict(state, [batch])))
             if n:
                 probs.append(p[:n])
@@ -504,11 +500,11 @@ def _task_infer(trainer: Trainer, cfg: Config) -> Dict[str, float]:
         counts = np.asarray(multihost_utils.process_allgather(
             np.asarray([n_local]))).reshape(-1)
     else:
-        for batch in it:
+        for batch in pipeline:
             n = batch["label"].shape[0]
             n_local += n
             if n < local_bs:  # pad tail to the compiled shape, trim after
-                batch = _pad_batch(batch, local_bs)
+                batch = pad_batch(batch, local_bs)
             probs.append(next(iter(trainer.predict(state, [batch])))[:n])
     local = (np.concatenate(probs) if probs
              else np.zeros((0,), np.float32)).astype(np.float32)
