@@ -11,9 +11,10 @@ driver runs this on one real TPU chip). Host batches are pre-staged so the
 number isolates transfer+device throughput; disk decode is benched separately
 (~1.2M ex/s on this 1-core host, see BASELINE.md).
 
-Also probes 1->8 data-parallel scaling efficiency on a virtual 8-device CPU
-mesh (wiring-level truth: real multi-chip hardware is not available; the
-collective layout is identical). Disable with --no-scaling.
+Also runs an 8-way-DP wiring check on a virtual 8-device CPU mesh (the
+collective layout is identical to real multi-chip; the aggregate ratio it
+reports is time-slicing overhead, NOT hardware scaling — real multi-chip
+hardware is not available this round). Disable with --no-scaling.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "examples/sec", "vs_baseline": N, ...}
@@ -117,7 +118,7 @@ def scaling_probe() -> None:
     print(json.dumps({
         "one_dev_eps": round(r1["total_eps"], 1),
         "eight_dev_eps": round(r8["total_eps"], 1),
-        "scaling_efficiency_1to8": round(eff, 3),
+        "aggregate_ratio_8v1": round(eff, 3),
     }))
 
 
@@ -173,8 +174,15 @@ def main() -> None:
         "aggregate_eps": round(r["total_eps"], 1),
     }
     if scaling is not None:
-        result["scaling_efficiency_1to8_cpu_mesh"] = (
-            scaling["scaling_efficiency_1to8"])
+        # Deliberately NOT named "scaling efficiency": 8 VIRTUAL XLA devices
+        # time-slice this host's core(s), so the aggregate ratio mostly
+        # measures time-slicing (~1/8 on a 1-core host), not hardware
+        # scaling. Its value here is wiring-level: the 8-way DP collective
+        # program compiled and executed. Real scaling needs real chips.
+        result["dp8_virtual_cpu_mesh_check"] = {
+            "ok": True,
+            "aggregate_ratio_8v1_timeslicing": scaling["aggregate_ratio_8v1"],
+        }
     print(json.dumps(result))
 
 

@@ -19,6 +19,7 @@ Outputs fixed-shape batches ``{"feat_ids": int32[B,F], "feat_vals": f32[B,F],
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from typing import BinaryIO, Dict, Iterator, List, Optional, Sequence, Tuple
@@ -124,6 +125,13 @@ def _iter_file_records(path: str, use_native: bool, verify_crc: bool = True
     yield from tfrecord.iter_records(path, verify_crc=verify_crc)
 
 
+def _available_cores() -> int:
+    try:
+        return len(os.sched_getaffinity(0))  # respects cgroup/affinity limits
+    except AttributeError:  # non-Linux
+        return os.cpu_count() or 1
+
+
 class CtrPipeline:
     """TFRecord CTR input pipeline producing fixed-shape numpy batches."""
 
@@ -161,7 +169,12 @@ class CtrPipeline:
         self.drop_remainder = drop_remainder
         self.seed = seed
         self.prefetch_batches = prefetch_batches
-        self.reader_threads = max(reader_threads, 1)
+        # Clamp to AVAILABLE cores: on a 1-core host a 4-thread decode pool
+        # only adds contention (~6% measured); extra threads help only when
+        # the GIL-released C decoder can actually run in parallel. Use the
+        # scheduler affinity mask where exposed (cgroup/CI-quota accurate),
+        # not os.cpu_count() (physical cores).
+        self.reader_threads = max(1, min(reader_threads, _available_cores()))
         self._use_native = use_native_decoder
         self.verify_crc = verify_crc
         # Shifts the internal epoch index used for shuffle seeding. The task
@@ -239,15 +252,29 @@ class CtrPipeline:
         the pool, then slice batches — at least the record path's shuffle
         quality (the pool is the whole epoch on small data, a >= 64MB window
         on large), with zero per-record Python."""
+        for rows, _, _ in self._iter_pooled(loader, 1):
+            yield rows
+
+    def _iter_pooled(self, loader, k: int
+                     ) -> Iterator[Tuple[Batch, int, int]]:
+        """THE pool/permute/drain machinery (single source for both the
+        per-batch and the k-step superbatch feeds): yields ``(rows, m,
+        n_examples)`` where ``rows`` is ``m`` stacked batches as contiguous
+        ``[m*batch_size, ...]`` arrays (``m <= k``; the tail of each epoch
+        emits single batches, the last possibly short). Non-final drains
+        emit only full ``k*bs`` groups so k-groups stay contiguous pool
+        slices; the per-epoch file shuffle and pool permutation are seeded
+        from (seed, epoch + epoch_offset) exactly like the record path."""
         bs = self.batch_size
+        sb = bs * max(k, 1)
         for e in range(self.num_epochs):
             epoch = e + self.epoch_offset
             rng = np.random.default_rng(self.seed * 1_000_003 + epoch)
-            pool_target = max(self.shuffle_buffer, bs) if self.shuffle else bs
+            pool_target = max(self.shuffle_buffer, sb) if self.shuffle else sb
             pend: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
             n_pend = 0
 
-            def drain(final: bool) -> Iterator[Batch]:
+            def drain(final: bool) -> Iterator[Tuple[Batch, int, int]]:
                 nonlocal pend, n_pend
                 if self.shuffle and len(pend) > 0:
                     labels = np.concatenate([t[0] for t in pend])
@@ -255,14 +282,16 @@ class CtrPipeline:
                     vals = np.concatenate([t[2] for t in pend])
                     perm = rng.permutation(len(labels))
                     pend = [(labels[perm], ids[perm], vals[perm])]
-                emit = n_pend if final else (n_pend // bs) * bs
-                while emit >= bs:
-                    yield self._assemble_batch(pend, bs)
-                    emit -= bs
-                    n_pend -= bs
-                if final and n_pend and not self.drop_remainder:
-                    yield self._assemble_batch(pend, n_pend)
-                    n_pend = 0
+                while n_pend >= sb:
+                    yield self._assemble_batch(pend, sb), k, sb
+                    n_pend -= sb
+                if final:
+                    while n_pend >= bs:
+                        yield self._assemble_batch(pend, bs), 1, bs
+                        n_pend -= bs
+                    if n_pend and not self.drop_remainder:
+                        yield self._assemble_batch(pend, n_pend), 1, n_pend
+                        n_pend = 0
 
             for chunk in self._iter_decoded_chunks(epoch, loader):
                 pend.append(chunk)
@@ -270,6 +299,47 @@ class CtrPipeline:
                 if n_pend >= pool_target:
                     yield from drain(final=False)
             yield from drain(final=True)
+
+    def iter_superbatches(self, k: int
+                          ) -> Iterator[Tuple[Batch, int, int]]:
+        """Yield ``(rows, m, n_examples)`` where ``rows`` holds ``m`` stacked
+        batches as contiguous ``[m*batch_size, ...]`` arrays (``m <= k``;
+        tail emissions may be single short batches with ``m == 1``).
+
+        This is the zero-copy feed for the K-step dispatch loop: after the
+        shuffle pool is permuted it is ONE contiguous array, so slicing
+        ``k*bs`` rows and reshaping to ``[k, bs, ...]`` at transfer time
+        costs nothing — versus ``np.stack`` over k single batches, which
+        re-copies every row on the host core that is also doing the decode
+        (the e2e bottleneck on small hosts; VERDICT r2 #5).
+        """
+        bs = self.batch_size
+        loader = _native_loader() if self._use_native else None
+        if loader is None or k <= 1:
+            # Per-record path: group plain batches (stack copy at transfer).
+            group: List[Batch] = []
+            for b in self:
+                if b["label"].shape[0] == bs:
+                    group.append(b)
+                    if len(group) == k:
+                        yield self._stack_group(group), k, k * bs
+                        group = []
+                else:  # short tail: flush pending then emit single
+                    for g in group:
+                        yield g, 1, bs
+                    group = []
+                    yield b, 1, b["label"].shape[0]
+            for g in group:
+                yield g, 1, bs
+            return
+        yield from self._iter_pooled(loader, k)
+
+    @staticmethod
+    def _stack_group(group: List[Batch]) -> Batch:
+        """Flatten k same-size batches to [k*bs, ...] rows (copies; only the
+        non-native fallback pays this)."""
+        return {key: np.concatenate([b[key] for b in group])
+                for key in group[0]}
 
     @staticmethod
     def _assemble_batch(pend: List[Tuple[np.ndarray, np.ndarray, np.ndarray]],

@@ -256,6 +256,20 @@ class Trainer:
         host->device move (batch dim sharded over 'data', K replicated)."""
         stacked = {
             key: np.stack([b[key] for b in batches]) for key in batches[0]}
+        return self._put_stacked(stacked)
+
+    def put_superbatch_rows(self, rows: Dict[str, np.ndarray], k: int
+                            ) -> Dict[str, jax.Array]:
+        """[k*B, ...] contiguous rows -> [k, B, ...] device arrays. The
+        reshape is free (contiguous view), so a pipeline emitting pool
+        slices (CtrPipeline.iter_superbatches) reaches the device with zero
+        host-side stacking copies."""
+        stacked = {key: v.reshape(k, v.shape[0] // k, *v.shape[1:])
+                   for key, v in rows.items()}
+        return self._put_stacked(stacked)
+
+    def _put_stacked(self, stacked: Dict[str, np.ndarray]
+                     ) -> Dict[str, jax.Array]:
         mi = self.mesh_info
         if mi.mesh is None:
             return jax.device_put(stacked)
@@ -385,9 +399,20 @@ class Trainer:
         the host->device transfer with step dispatch (the prefetch-to-device
         iterator analog of X3). Yields (device_batches, n_steps, n_local_ex).
         A tail group smaller than K is staged as single steps (no recompile
-        for odd sizes)."""
+        for odd sizes).
+
+        Fast path: a source exposing ``iter_superbatches`` (CtrPipeline)
+        emits pre-grouped contiguous rows, skipping the np.stack copy."""
+        sb_iter = getattr(batches, "iter_superbatches", None)
 
         def gen():
+            if sb_iter is not None and k > 1:
+                for rows, m, n_ex in sb_iter(k):
+                    if m == 1:
+                        yield self.put_batch(rows), 1, n_ex
+                    else:
+                        yield self.put_superbatch_rows(rows, m), m, n_ex
+                return
             group = []
             for b in batches:
                 group.append(b)

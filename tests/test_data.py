@@ -346,6 +346,47 @@ class TestPipeline:
                 [b["feat_ids"] for b in p]))
         assert not np.array_equal(orders[0], orders[1])
 
+    @pytest.mark.parametrize("drop", [True, False])
+    def test_superbatches_cover_same_examples(self, data_dir, drop):
+        """iter_superbatches (the zero-copy K-step feed) must cover exactly
+        the records the single-batch path covers: same multiset, same total
+        step count, groups of at most k, tail emitted as singles."""
+        kw = dict(field_size=6, batch_size=32, num_epochs=1, shuffle=True,
+                  shuffle_buffer=1000, seed=3, drop_remainder=drop,
+                  prefetch_batches=0)
+        singles = pipeline.CtrPipeline(self._files(data_dir), **kw)
+        ids_single = np.concatenate(
+            [b["feat_ids"] for b in singles])
+        n_batches = sum(1 for _ in pipeline.CtrPipeline(
+            self._files(data_dir), **kw))
+
+        sb = pipeline.CtrPipeline(self._files(data_dir), **kw)
+        total_steps, rows_all = 0, []
+        for rows, m, n_ex in sb.iter_superbatches(3):
+            assert 1 <= m <= 3
+            assert rows["feat_ids"].shape[0] == n_ex
+            if m > 1:
+                assert n_ex == m * 32  # full groups reshape to [m, bs]
+            total_steps += m
+            rows_all.append(rows["feat_ids"])
+        ids_super = np.concatenate(rows_all)
+        assert total_steps == n_batches
+        assert (sorted(map(tuple, ids_single.tolist()))
+                == sorted(map(tuple, ids_super.tolist())))
+
+    def test_superbatches_python_decoder_fallback(self, data_dir):
+        """The non-native path groups plain batches (stack copy) but keeps
+        the same contract."""
+        p = pipeline.CtrPipeline(
+            self._files(data_dir), field_size=6, batch_size=32,
+            shuffle=False, drop_remainder=False, prefetch_batches=0,
+            use_native_decoder=False)
+        total = 0
+        for rows, m, n_ex in p.iter_superbatches(2):
+            total += n_ex
+            assert rows["feat_ids"].shape[0] == n_ex
+        assert total == 150
+
     def test_sharded_pipelines_partition_data(self, data_dir):
         files = self._files(data_dir)
         seen = []
