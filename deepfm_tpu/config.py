@@ -98,6 +98,10 @@ class Config:
     compute_dtype: str = "bfloat16"   # MXU-friendly activations/matmuls
     remat: bool = False               # jax.checkpoint the DNN tower
     use_pallas: bool = True           # fused Pallas FM kernel when on TPU
+    # Row-sharded lookup collective: masked_psum (traffic ∝ batch; the CTR
+    # default) or allgather_table (traffic ∝ table; huge-batch/small-table
+    # regimes). See TUNING.md "Sharded embedding lookup".
+    embedding_lookup: str = "masked_psum"
 
     # ---- checkpoint / export / logging ----
     model_dir: str = ""               # checkpoint dir (shared storage; reference :434)
@@ -126,6 +130,9 @@ class Config:
             raise ValueError(f"unknown optimizer: {self.optimizer!r}")
         if self.loss_type not in ("log_loss", "square_loss"):
             raise ValueError(f"unknown loss_type: {self.loss_type!r}")
+        if self.embedding_lookup not in ("masked_psum", "allgather_table"):
+            raise ValueError(
+                f"unknown embedding_lookup: {self.embedding_lookup!r}")
         if self.feature_size <= 0 or self.field_size <= 0 or self.embedding_size <= 0:
             raise ValueError("feature_size/field_size/embedding_size must be positive")
         if self.batch_size <= 0:

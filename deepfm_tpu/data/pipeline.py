@@ -151,7 +151,7 @@ class CtrPipeline:
         prefetch_batches: int = 4,
         use_native_decoder: bool = True,
         reader_threads: int = 4,
-        verify_crc: bool = True,
+        verify_crc: bool = False,  # matches Config/tf.data default; codec fns keep True
         epoch_offset: int = 0,
     ):
         if shard is not None:
@@ -518,7 +518,7 @@ class StreamingCtrPipeline:
         prefetch_batches: int = 4,
         use_native_decoder: bool = True,
         record_shard: Optional[Tuple[int, int]] = None,
-        verify_crc: bool = True,
+        verify_crc: bool = False,  # matches Config/tf.data default; codec fns keep True
     ):
         self.stream = stream
         self.field_size = field_size

@@ -74,7 +74,8 @@ class DCNv2(DeepFM):
         cdt = jnp.dtype(cfg.compute_dtype)
         feat_vals = feat_vals.astype(jnp.float32)
 
-        v = emb_ops.lookup(params["fm_v"], feat_ids, axis_name=shard_axis)
+        v = emb_ops.lookup(params["fm_v"], feat_ids, axis_name=shard_axis,
+                           strategy=cfg.embedding_lookup)
         xv = v * feat_vals[..., None]
         x0 = xv.reshape(xv.shape[0], cfg.field_size * cfg.embedding_size)
 

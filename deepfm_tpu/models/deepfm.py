@@ -68,9 +68,11 @@ class DeepFM:
         feat_vals = feat_vals.astype(jnp.float32)
 
         # First-order: sum_f W[ids]*vals   (reference :177-179)
-        w = emb_ops.lookup(params["fm_w"], feat_ids, axis_name=shard_axis)  # [B,F]
+        w = emb_ops.lookup(params["fm_w"], feat_ids, axis_name=shard_axis,
+                           strategy=cfg.embedding_lookup)  # [B,F]
         # Second-order FM over xv = V[ids]*vals   (reference :181-187)
-        v = emb_ops.lookup(params["fm_v"], feat_ids, axis_name=shard_axis)  # [B,F,K]
+        v = emb_ops.lookup(params["fm_v"], feat_ids, axis_name=shard_axis,
+                           strategy=cfg.embedding_lookup)  # [B,F,K]
         xv = v * feat_vals[..., None]
         if cfg.use_pallas and pallas_fm.supported(cfg.field_size,
                                                  cfg.embedding_size):

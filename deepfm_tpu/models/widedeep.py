@@ -37,11 +37,13 @@ class WideDeep(DeepFM):
         feat_vals = feat_vals.astype(jnp.float32)
 
         # Wide: linear over sparse features (first-order part of DeepFM).
-        w = emb_ops.lookup(params["fm_w"], feat_ids, axis_name=shard_axis)
+        w = emb_ops.lookup(params["fm_w"], feat_ids, axis_name=shard_axis,
+                           strategy=cfg.embedding_lookup)
         y_wide = jnp.sum(w * feat_vals, axis=1)
 
         # Deep: tower over embedded features.
-        v = emb_ops.lookup(params["fm_v"], feat_ids, axis_name=shard_axis)
+        v = emb_ops.lookup(params["fm_v"], feat_ids, axis_name=shard_axis,
+                           strategy=cfg.embedding_lookup)
         xv = v * feat_vals[..., None]
         deep_in = xv.reshape(xv.shape[0], cfg.field_size * cfg.embedding_size)
         y_d, new_state = common.apply_tower(

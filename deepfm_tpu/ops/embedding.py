@@ -19,16 +19,33 @@ import jax
 import jax.numpy as jnp
 
 
-def lookup(table: jax.Array, ids: jax.Array, *, axis_name: Optional[str] = None) -> jax.Array:
+def lookup(table: jax.Array, ids: jax.Array, *,
+           axis_name: Optional[str] = None,
+           strategy: str = "masked_psum") -> jax.Array:
     """Gather rows of ``table`` at ``ids``.
 
     table: [V, ...] (or local shard [V/m, ...] inside shard_map)
     ids:   int32 [...]
-    Returns [..., *table.shape[1:]] (f32), summed over ``axis_name`` shards
-    when given.
+    Returns [..., *table.shape[1:]] (f32), reassembled across ``axis_name``
+    shards when given.
+
+    ``strategy`` selects the collective pattern for the sharded case (see
+    TUNING.md §"Sharded embedding lookup" for the measured/analytic
+    comparison):
+
+    * ``masked_psum`` (default): local masked gather + psum of the [B,F,K]
+      activations — traffic ∝ batch, wins when B·F ≪ V (the CTR regime:
+      activations ~1.3 MB vs a ~15 MB table at the reference shape).
+    * ``allgather_table``: all_gather the shards into the full table, then
+      plain gather — traffic ∝ V·K, wins only when B·F ≫ V (huge batches
+      over small tables); backward reduce-scatters the table cotangent.
     """
     if axis_name is None:
         return jnp.take(table, ids, axis=0)
+    if strategy == "allgather_table":
+        return sharded_lookup_allgather(table, ids, axis_name)
+    if strategy != "masked_psum":
+        raise ValueError(f"unknown embedding lookup strategy {strategy!r}")
     return sharded_lookup(table, ids, axis_name)
 
 
@@ -50,6 +67,31 @@ def sharded_lookup(local_table: jax.Array, ids: jax.Array, axis_name: str) -> ja
         mask = jnp.expand_dims(in_range, tuple(range(ids.ndim, emb.ndim)))
     emb = jnp.where(mask, emb, jnp.zeros((), emb.dtype))
     return jax.lax.psum(emb, axis_name)
+
+
+def sharded_lookup_allgather(local_table: jax.Array, ids: jax.Array,
+                             axis_name: str) -> jax.Array:
+    """Row-sharded gather via table reassembly: rebuild the full [V, ...]
+    table on every shard, then a plain local gather.
+
+    Implemented as scatter-into-zeros + psum rather than ``lax.all_gather``:
+    the result is identical, XLA recognizes the pattern, and psum's output
+    is *provably replicated* over the axis, which ``shard_map(check_vma)``
+    requires downstream (all_gather output is conservatively marked
+    axis-varying). Communication is O(V·K) per step independent of batch
+    (vs masked+psum's O(B·F·K)); the table cotangent reduces back with the
+    transposed collective. Only competitive when ids volume exceeds table
+    volume — exposed for A/B (scripts/bench_embedding.py, TUNING.md) and
+    for large-batch/small-table regimes via cfg.embedding_lookup."""
+    m = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    rows_local = local_table.shape[0]
+    full = jnp.zeros((rows_local * m, *local_table.shape[1:]),
+                     local_table.dtype)
+    full = jax.lax.dynamic_update_slice_in_dim(
+        full, local_table, idx * rows_local, axis=0)
+    full = jax.lax.psum(full, axis_name)
+    return jnp.take(full, ids.astype(jnp.int32), axis=0)
 
 
 def padded_vocab(feature_size: int, num_shards: int) -> int:

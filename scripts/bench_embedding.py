@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""A/B the row-sharded embedding lookup strategies (SURVEY hard-part #1).
+
+Compares ``masked_psum`` (local masked gather + psum of activations) vs
+``allgather_table`` (reassemble table, plain gather) under shard_map on a
+virtual 8-device mesh: forward+backward wall time at CTR shapes, plus the
+analytic per-step collective traffic that decides the winner on real ICI
+(virtual CPU devices share one memory — the timing here captures compute
+and program overhead only, NOT interconnect cost; the bytes column is the
+hardware-relevant signal).
+
+Usage: python scripts/bench_embedding.py [--devices 8]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from __graft_entry__ import _provision_virtual_devices  # noqa: E402
+
+
+def bench(v: int, k: int, b: int, f: int, m: int, data: int) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from deepfm_tpu.ops import embedding as emb
+
+    devs = np.array(jax.devices()[:m * data]).reshape(data, m)
+    mesh = Mesh(devs, ("data", "model"))
+    vp = emb.padded_vocab(v, m)
+    table = jax.device_put(
+        np.random.default_rng(0).normal(size=(vp, k)).astype(np.float32),
+        jax.sharding.NamedSharding(mesh, P("model", None)))
+    ids = jax.device_put(
+        np.random.default_rng(1).integers(0, v, (b, f)).astype(np.int32),
+        jax.sharding.NamedSharding(mesh, P("data", None)))
+
+    def make(strategy):
+        def loss(tab, i):
+            e = emb.lookup(tab, i, axis_name="model", strategy=strategy)
+            return jnp.sum(e * e)
+        def step(tab, i):
+            l, g = jax.value_and_grad(loss)(tab, i)
+            # pmean over both axes: value-level no-op on already-replicated
+            # losses, but lets shard_map's VMA checker prove replication.
+            return jax.lax.pmean(jax.lax.pmean(l, "data"), "model"), g
+        return jax.jit(shard_map(
+            step, mesh=mesh,
+            in_specs=(P("model", None), P("data", None)),
+            out_specs=(P(), P("model", None))))
+
+    rows = {}
+    for strategy in ("masked_psum", "allgather_table"):
+        fn = make(strategy)
+        l, g = fn(table, ids)  # compile
+        jax.block_until_ready(g)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(5):
+                l, g = fn(table, ids)
+            jax.block_until_ready(g)
+            best = min(best, (time.perf_counter() - t0) / 5)
+        rows[strategy] = best * 1000
+
+    # Analytic per-step collective traffic per device link (ring, fwd+bwd):
+    # masked_psum: psum([B/data, F, K]) fwd + nothing extra bwd (cotangent is
+    #   already local after masking) -> 2*(m-1)/m * B/data*F*K words.
+    # allgather_table: all_gather(V/m..V) fwd + reduce_scatter grad bwd
+    #   -> 2*(m-1)/m * V*K words.
+    act_words = (b // data) * f * k
+    psum_traffic = 2 * (m - 1) / m * act_words * 4
+    ag_traffic = 2 * (m - 1) / m * vp * k * 4
+    print(json.dumps({
+        "shape": {"V": v, "K": k, "B": b, "F": f,
+                  "mesh": f"{data}x{m}"},
+        "masked_psum_ms": round(rows["masked_psum"], 3),
+        "allgather_table_ms": round(rows["allgather_table"], 3),
+        "masked_psum_traffic_MB": round(psum_traffic / 1e6, 2),
+        "allgather_table_traffic_MB": round(ag_traffic / 1e6, 2),
+    }))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=8)
+    args = ap.parse_args()
+    _provision_virtual_devices(args.devices)
+
+    # Reference CTR shape: activations << table -> psum should win on ICI.
+    bench(v=117_581, k=32, b=1024, f=39, m=2, data=args.devices // 2)
+    bench(v=117_581, k=32, b=1024, f=39, m=args.devices, data=1)
+    # Small-table / huge-batch regime: table << activations -> all_gather.
+    bench(v=4_096, k=32, b=16_384, f=39, m=args.devices, data=1)
+
+
+if __name__ == "__main__":
+    main()
