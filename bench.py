@@ -134,9 +134,36 @@ def main() -> None:
         scaling_probe()
         return
 
+    # Pallas compiled-path smoke FIRST (subprocess, before this process
+    # claims the chip): fwd+bwd of the fused FM kernel vs the jnp oracle on
+    # real TPU + one full train step (scripts/tpu_smoke.py). Recorded in the
+    # headline JSON so the "compiled Pallas path works on hardware" claim
+    # ships with every bench run instead of resting on prose.
+    pallas_smoke = None
+    try:
+        smoke = subprocess.run(
+            [sys.executable, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "scripts", "tpu_smoke.py")],
+            capture_output=True, text=True, timeout=600)
+        if "SKIP" in smoke.stdout:
+            # Two distinct skip reasons — don't conflate "this host is not a
+            # TPU" with "the kernel doesn't support this shape on a TPU".
+            pallas_smoke = ("skip_not_tpu" if "not tpu" in smoke.stdout
+                            else "skip_unsupported_shape")
+        elif smoke.returncode == 0 and "PASS" in smoke.stdout:
+            pallas_smoke = "pass"
+        else:
+            pallas_smoke = "fail"
+            print(f"bench: pallas smoke FAILED:\n{smoke.stdout[-1500:]}"
+                  f"\n{smoke.stderr[-1500:]}", file=sys.stderr)
+    except (subprocess.TimeoutExpired, OSError) as e:
+        pallas_smoke = f"error: {e}"
+
     import jax
 
-    print(f"bench: devices={jax.devices()}", file=sys.stderr)
+    print(f"bench: devices={jax.devices()} pallas_smoke={pallas_smoke}",
+          file=sys.stderr)
     r = measure(_bench_cfg())
     print(
         f"bench: {r['ms_per_step']:.3f} ms/step, total {r['total_eps']:,.0f} "
@@ -172,6 +199,7 @@ def main() -> None:
         "vs_baseline": round(r["per_chip_eps"] / nominal_per_accel_baseline, 3),
         "devices": r["devices"],
         "aggregate_eps": round(r["total_eps"], 1),
+        "pallas_smoke": pallas_smoke,
     }
     if scaling is not None:
         # Deliberately NOT named "scaling efficiency": 8 VIRTUAL XLA devices

@@ -332,7 +332,14 @@ class CtrPipeline:
             for g in group:
                 yield g, 1, bs
             return
-        yield from self._iter_pooled(loader, k)
+        # Native pooled path bypasses __iter__'s prefetch; add the
+        # decode-ahead stage here (depth in k-groups) so decode overlaps the
+        # consumer's transfer+dispatch work. The fallback above iterates
+        # ``self`` and is therefore already prefetched.
+        src = self._iter_pooled(loader, k)
+        if self.prefetch_batches > 0:
+            src = _prefetch(src, max(1, self.prefetch_batches // k))
+        yield from src
 
     @staticmethod
     def _stack_group(group: List[Batch]) -> Batch:

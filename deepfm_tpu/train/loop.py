@@ -407,16 +407,7 @@ class Trainer:
 
         def gen():
             if sb_iter is not None and k > 1:
-                src = sb_iter(k)
-                # Keep the pipeline's decode-ahead stage: the raw superbatch
-                # iterator bypasses CtrPipeline.__iter__'s _prefetch, so
-                # decode would otherwise serialize with transfer on this
-                # staging thread. Depth is in k-groups.
-                pf = getattr(batches, "prefetch_batches", 0)
-                if pf > 0:
-                    from ..data.pipeline import _prefetch  # noqa: PLC0415
-                    src = _prefetch(src, max(1, pf // k))
-                for rows, m, n_ex in src:
+                for rows, m, n_ex in sb_iter(k):
                     if m == 1:
                         yield self.put_batch(rows), 1, n_ex
                     else:
