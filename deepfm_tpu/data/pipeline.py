@@ -153,6 +153,7 @@ class CtrPipeline:
         reader_threads: int = 4,
         verify_crc: bool = False,  # matches Config/tf.data default; codec fns keep True
         epoch_offset: int = 0,
+        skip_batches: int = 0,
     ):
         if shard is not None:
             self._files: Tuple[str, ...] = shard.files
@@ -183,6 +184,14 @@ class CtrPipeline:
         # offset every driver epoch would replay epoch-0's byte-identical
         # shuffle order (VERDICT r2 weak #2).
         self.epoch_offset = epoch_offset
+        # Step-accurate resume: drop the first N emitted batches (the
+        # already-trained prefix of an interrupted epoch). Applied INSIDE
+        # each emission path so the skipped stream is identical to the one
+        # the interrupted run trained on — an external wrapper would both
+        # hide iter_superbatches (killing the zero-copy feed) and, worse,
+        # skip along the k=1 pooled stream while training had consumed the
+        # k-pooled stream, whose batch order differs past the first drain.
+        self.skip_batches = skip_batches
         self._decode = _get_decoder(use_native_decoder)
 
     # ------------------------------------------------------------------
@@ -257,6 +266,26 @@ class CtrPipeline:
 
     def _iter_pooled(self, loader, k: int
                      ) -> Iterator[Tuple[Batch, int, int]]:
+        """``_iter_pooled_raw`` with the resume skip applied: the first
+        ``skip_batches`` batches are trimmed FROM THIS stream (whole
+        emissions dropped; a partially-trained group is sliced — the rows
+        stay one contiguous block), so the surviving order is exactly what
+        an uninterrupted run would have trained after that prefix."""
+        skip = self.skip_batches
+        bs = self.batch_size
+        for rows, m, n_ex in self._iter_pooled_raw(loader, k):
+            if skip:
+                if m <= skip:
+                    skip -= m
+                    continue
+                rows = {key: v[skip * bs:] for key, v in rows.items()}
+                m -= skip
+                n_ex -= skip * bs
+                skip = 0
+            yield rows, m, n_ex
+
+    def _iter_pooled_raw(self, loader, k: int
+                         ) -> Iterator[Tuple[Batch, int, int]]:
         """THE pool/permute/drain machinery (single source for both the
         per-batch and the k-step superbatch feeds): yields ``(rows, m,
         n_examples)`` where ``rows`` is ``m`` stacked batches as contiguous
@@ -414,16 +443,23 @@ class CtrPipeline:
         yield from buf
 
     def _iter_batches_sync(self) -> Iterator[Batch]:
+        skip = self.skip_batches
         for e in range(self.num_epochs):
             epoch = e + self.epoch_offset
             pending: List[bytes] = []
             for rec in self._iter_shuffled(epoch):
                 pending.append(rec)
                 if len(pending) == self.batch_size:
-                    yield self._make_batch(pending)
+                    if skip:
+                        skip -= 1
+                    else:
+                        yield self._make_batch(pending)
                     pending = []
             if pending and not self.drop_remainder:
-                yield self._make_batch(pending)
+                if skip:
+                    skip -= 1
+                else:
+                    yield self._make_batch(pending)
 
     def _make_batch(self, records: List[bytes]) -> Batch:
         labels, ids, vals = self._decode(records, self.field_size)
@@ -526,6 +562,7 @@ class StreamingCtrPipeline:
         use_native_decoder: bool = True,
         record_shard: Optional[Tuple[int, int]] = None,
         verify_crc: bool = False,  # matches Config/tf.data default; codec fns keep True
+        skip_batches: int = 0,
     ):
         self.stream = stream
         self.field_size = field_size
@@ -536,6 +573,7 @@ class StreamingCtrPipeline:
         self._decode = _get_decoder(use_native_decoder)
         self._record_shard = record_shard
         self.verify_crc = verify_crc
+        self.skip_batches = skip_batches  # resume: drop the trained prefix
         self._consumed = False
 
     def _iter_records(self) -> Iterator[bytes]:
@@ -611,10 +649,14 @@ class StreamingCtrPipeline:
                 "create a new stream for another epoch")
         self._consumed = True
         loader = _native_loader() if self._use_native else None
-        if loader is not None:
-            yield from self._iter_vectorized(loader)
-        else:
-            yield from self._iter_record_batches()
+        src = (self._iter_vectorized(loader) if loader is not None
+               else self._iter_record_batches())
+        skip = self.skip_batches
+        for b in src:
+            if skip:
+                skip -= 1
+                continue
+            yield b
 
     def __iter__(self) -> Iterator[Batch]:
         if self.prefetch_batches <= 0:

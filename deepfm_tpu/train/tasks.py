@@ -150,10 +150,12 @@ def _validate_shard_coverage(cfg: Config, files: List[str]) -> None:
 def make_pipeline(cfg: Config, files: List[str], *, epochs: int = 1,
                   shuffle: bool = True, sharded: bool = True,
                   drop_remainder: Optional[bool] = None,
-                  epoch_offset: int = 0) -> pipe_lib.CtrPipeline:
+                  epoch_offset: int = 0,
+                  skip_batches: int = 0) -> pipe_lib.CtrPipeline:
     return pipe_lib.CtrPipeline(
         files,
         epoch_offset=epoch_offset,
+        skip_batches=skip_batches,
         field_size=cfg.field_size,
         batch_size=_local_batch_size(cfg),
         num_epochs=epochs,
@@ -177,7 +179,8 @@ def _eval_pipeline(cfg: Config, va_files: List[str]) -> pipe_lib.CtrPipeline:
     return make_pipeline(cfg, va_files, shuffle=False, drop_remainder=False)
 
 
-def make_streaming_pipeline(cfg: Config, files: List[str], *, epochs: int = 1
+def make_streaming_pipeline(cfg: Config, files: List[str], *, epochs: int = 1,
+                            skip_batches: int = 0
                             ) -> pipe_lib.StreamingCtrPipeline:
     """Pipe-mode analog (``--pipe_mode 1``): one sequential single-pass
     stream over this process's file shard, epochs replayed producer-side
@@ -196,6 +199,7 @@ def make_streaming_pipeline(cfg: Config, files: List[str], *, epochs: int = 1
         prefetch_batches=cfg.prefetch_batches,
         use_native_decoder=cfg.use_native_decoder,
         record_shard=shard.record_shard,
+        skip_batches=skip_batches,
         verify_crc=cfg.verify_crc,
     )
 
@@ -315,6 +319,68 @@ def _make_throttled_eval_hook(trainer: Trainer, cfg: Config,
     return hook
 
 
+_RESUME_META = "resume_meta.json"
+
+
+def _write_resume_meta(model_dir: str, meta: Dict) -> None:
+    """Chief-only sidecar recording data-pipeline position alongside each
+    checkpoint — the step-accurate-resume half the checkpoint itself can't
+    carry (SURVEY hard-part #5; the reference punts and replays the epoch)."""
+    if not bootstrap.is_chief():
+        return
+    import json  # noqa: PLC0415
+    with fileio.open_stream(fileio.join(model_dir, _RESUME_META), "w") as f:
+        json.dump(meta, f)
+
+
+def _read_resume_meta(model_dir: str) -> Optional[Dict]:
+    import json  # noqa: PLC0415
+    path = fileio.join(model_dir, _RESUME_META)
+    if not fileio.exists(path):
+        return None
+    try:
+        with fileio.open_stream(path, "r") as f:
+            return json.load(f)
+    except (ValueError, OSError):  # torn write / unreadable: ignore
+        return None
+
+
+def _consumption_layout(cfg: Config) -> List[int]:
+    """Fingerprint of HOW batches are consumed. The pooled emission order
+    depends on it (k-group drains vs per-batch drains, per-rank sharding),
+    so a mid-epoch skip is only exact when the resuming run consumes the
+    same way the interrupted run did."""
+    return [jax.process_count(), cfg.steps_per_loop,
+            int(cfg.use_native_decoder)]
+
+
+def _resume_position(cfg: Config, restored_step: int
+                     ) -> Tuple[int, int, int]:
+    """(epoch_base, start_epoch, skip_batches) for this invocation.
+
+    The sidecar applies only when its ``step`` matches the restored
+    checkpoint exactly (an async save that never became durable leaves a
+    stale sidecar -> ignored, degrading to the reference's replay-the-epoch
+    behavior). A cleanly-completed prior invocation advances ``epoch_base``
+    so shuffle orders never repeat across resume-for-more-epochs runs; an
+    interrupted invocation with the same num_epochs/pipe_mode resumes
+    mid-epoch, skipping the batches already trained."""
+    meta = _read_resume_meta(cfg.model_dir) if cfg.model_dir else None
+    if not meta or not restored_step or meta.get("step") != restored_step:
+        return 0, 0, 0
+    if meta.get("completed"):
+        return (int(meta.get("epoch_base", 0)) + int(meta.get("num_epochs", 0)),
+                0, 0)
+    if (int(meta.get("num_epochs", -1)) == cfg.num_epochs
+            and bool(meta.get("pipe_mode")) == bool(cfg.pipe_mode)
+            and meta.get("layout") == _consumption_layout(cfg)):
+        return (int(meta.get("epoch_base", 0)), int(meta.get("epoch", 0)),
+                int(meta.get("steps_into_epoch", 0)))
+    # Different invocation shape: start a fresh run but keep seeds moving.
+    return (int(meta.get("epoch_base", 0)) + int(meta.get("epoch", 0)) + 1,
+            0, 0)
+
+
 def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
     train_dir, eval_dir = resolve_channel_dirs(cfg)
     tr_files = resolve_files(train_dir, "tr")
@@ -339,6 +405,12 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
             cfg.model_dir, max_to_keep=cfg.keep_checkpoint_max,
             save_interval_steps=cfg.save_checkpoints_steps)
     state = _restore_or_init(trainer, cfg, require=False, mgr=mgr)
+    restored_step = int(state.step)
+    epoch_base, start_epoch, skip_batches = _resume_position(cfg, restored_step)
+    if start_epoch or skip_batches:
+        ulog.info(f"step-accurate resume: epoch {start_epoch} "
+                  f"(+{skip_batches} batches already trained), "
+                  f"epoch_base={epoch_base}")
 
     # train_and_evaluate semantics (reference 1-ps-cpu/...py:440-442,
     # REQUIRED there per README-EN.md:36-38): mid-train eval no earlier than
@@ -349,18 +421,33 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
         cfg.eval_start_delay_secs > 0 or cfg.eval_throttle_secs > 0)
 
     result: Dict[str, float] = {}
+    # Data-pipeline position for the resume sidecar; epoch_start is the
+    # global step at which the current epoch's batch 0 was (or would have
+    # been) trained, so steps_into_epoch == batches consumed this epoch.
+    progress = {"epoch": start_epoch,
+                "epoch_start": restored_step - skip_batches}
+
+    def _meta(step: int, completed: bool) -> Dict:
+        return {"step": step, "epoch": progress["epoch"],
+                "steps_into_epoch": step - progress["epoch_start"],
+                "epoch_base": epoch_base, "num_epochs": cfg.num_epochs,
+                "pipe_mode": int(cfg.pipe_mode),
+                "layout": _consumption_layout(cfg), "completed": completed}
+
     try:
         hooks = []
         if mgr is not None:
             # Host-side step counter: reading s.step would force a device
             # sync every step (it blocks on the async-dispatched update),
             # collapsing throughput — one sync at restore time instead.
-            step_counter = [int(state.step)]
+            step_counter = [restored_step]
 
             def ckpt_hook(s: TrainState, m) -> None:
                 step_counter[0] += int(m.get("steps_done", 1))
                 if mgr.should_save(step_counter[0]):
-                    mgr.save(step_counter[0], s)
+                    if mgr.save(step_counter[0], s):
+                        _write_resume_meta(
+                            cfg.model_dir, _meta(step_counter[0], False))
             hooks.append(ckpt_hook)
 
         tracer = prof_lib.StepWindowTracer(
@@ -375,8 +462,11 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                 # single-pass stream with all epochs replayed producer-side —
                 # the reference pipe-mode shape (``2-hvd-gpu/...py:403-405``,
                 # FIFO not reusable per epoch). Eval afterwards, file-mode.
+                # Resume: the already-trained stream prefix is skipped
+                # (epoch index stays 0 — position is steps into the stream).
                 pipeline = make_streaming_pipeline(
-                    cfg, tr_files, epochs=cfg.num_epochs)
+                    cfg, tr_files, epochs=cfg.num_epochs,
+                    skip_batches=skip_batches)
                 state, fit_m = trainer.fit(state, pipeline, hooks=hooks)
                 result["loss"] = fit_m["loss"]
                 result["examples_per_sec"] = fit_m.get("examples_per_sec", 0.0)
@@ -387,13 +477,24 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                               f"loss={ev['loss']:.5f}")
                     result.update({"auc": ev["auc"], "eval_loss": ev["loss"]})
             else:
-                for epoch in range(cfg.num_epochs):
+                for epoch in range(start_epoch, cfg.num_epochs):
                     # Per-epoch loop in the driver, per the reference's
                     # file-mode shape (``2-hvd-gpu/...py:390-394``). The
-                    # epoch index feeds the shuffle seed so each epoch sees
-                    # a fresh order (tf.data reshuffle_each_iteration analog).
-                    pipeline = make_pipeline(cfg, tr_files, epochs=1,
-                                             shuffle=True, epoch_offset=epoch)
+                    # epoch index (offset by epoch_base across invocations)
+                    # feeds the shuffle seed so each epoch sees a fresh
+                    # order (tf.data reshuffle_each_iteration analog) —
+                    # which is also what makes mid-epoch resume exact: the
+                    # resumed epoch replays the identical permutation and
+                    # skips the already-trained prefix.
+                    if mgr is not None:
+                        progress["epoch"] = epoch
+                        progress["epoch_start"] = step_counter[0] - (
+                            skip_batches if epoch == start_epoch else 0)
+                    pipeline = make_pipeline(
+                        cfg, tr_files, epochs=1, shuffle=True,
+                        epoch_offset=epoch_base + epoch,
+                        skip_batches=(skip_batches if epoch == start_epoch
+                                      else 0))
                     state, fit_m = trainer.fit(state, pipeline, hooks=hooks)
                     result["loss"] = fit_m["loss"]
                     result["examples_per_sec"] = fit_m.get(
@@ -416,7 +517,9 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
         finally:
             tracer.close()
         if mgr is not None:
-            mgr.save(int(state.step), state, force=True)
+            final_step = int(state.step)
+            mgr.save(final_step, state, force=True)
+            _write_resume_meta(cfg.model_dir, _meta(final_step, True))
     finally:
         if mgr is not None:
             mgr.close()

@@ -218,6 +218,205 @@ class TestShouldSaveCrossing:
             mgr2.close()
 
 
+class TestStepAccurateResume:
+    """SURVEY hard-part #5: preemption mid-epoch must resume at the exact
+    batch, not replay the epoch (the reference punts on this). Simulates a
+    spot kill by raising from the tracer hook after the interval checkpoint
+    landed, then re-runs the same invocation."""
+
+    def _cfg(self, workdir, model_dir, **kw):
+        base = dict(
+            feature_size=300, field_size=5, embedding_size=8,
+            deep_layers="16,8", dropout="1.0,1.0", batch_size=64,
+            compute_dtype="float32", learning_rate=0.05, num_epochs=2,
+            data_dir=str(workdir / "data"), val_data_dir="",
+            model_dir=model_dir, log_steps=0, steps_per_loop=1,
+            save_checkpoints_steps=5, mesh_data=1,
+            scale_lr_by_world=False, seed=3,
+        )
+        base.update(kw)
+        return Config(**base)
+
+    def test_mid_epoch_resume_exact(self, workdir, monkeypatch):
+        from deepfm_tpu.utils import profiling as prof_lib
+
+        model_dir = str(workdir / "ckpt_preempt")
+        cfg = self._cfg(workdir, model_dir)
+        steps_per_epoch = 3 * 256 // 64  # 12
+
+        class CrashAt:
+            def __init__(self, *a, **k):
+                self.n = 0
+
+            def on_step(self, steps_done=1):
+                self.n += steps_done
+                if self.n >= 7:
+                    raise RuntimeError("simulated preemption")
+
+            def close(self):
+                pass
+
+        orig_tracer = prof_lib.StepWindowTracer
+        monkeypatch.setattr(tasks.prof_lib, "StepWindowTracer", CrashAt)
+        with pytest.raises(RuntimeError, match="preemption"):
+            tasks.run(cfg)
+        monkeypatch.setattr(tasks.prof_lib, "StepWindowTracer", orig_tracer)
+
+        meta = tasks._read_resume_meta(model_dir)
+        assert meta == {"step": 5, "epoch": 0, "steps_into_epoch": 5,
+                        "epoch_base": 0, "num_epochs": 2, "pipe_mode": 0,
+                        "layout": [1, 1, 1], "completed": False}
+
+        # Resume the SAME invocation: restores step 5, skips the 5 trained
+        # batches of epoch 0, finishes epoch 0 + epoch 1 -> exactly 2 epochs
+        # total. (Epoch-replay semantics would end at 5 + 24 = 29.)
+        result = tasks.run(self._cfg(workdir, model_dir))
+        assert result["steps"] == 2 * steps_per_epoch
+
+        meta = tasks._read_resume_meta(model_dir)
+        assert meta["completed"] is True
+        assert meta["step"] == 2 * steps_per_epoch
+
+        # A fresh invocation after completion trains num_epochs MORE, with
+        # epoch_base advanced so shuffle orders don't repeat.
+        result = tasks.run(self._cfg(workdir, model_dir))
+        assert result["steps"] == 4 * steps_per_epoch
+        meta = tasks._read_resume_meta(model_dir)
+        assert meta["epoch_base"] == 2
+
+    def test_resume_matches_uninterrupted_run_k8(self, workdir, monkeypatch):
+        """Gold-standard exactness under the PRODUCTION config
+        (steps_per_loop=8, native loader): crash mid-epoch, resume, and the
+        final weights must match an uninterrupted run — proving the skip
+        trims the same k-pooled stream training consumes (a k=1 skip
+        stream would diverge past the first drain and silently train some
+        examples twice)."""
+        import numpy as np
+        from deepfm_tpu.utils import checkpoint as ckpt_lib
+        from deepfm_tpu.utils import profiling as prof_lib
+
+        ref_dir = str(workdir / "ckpt_ref_k8")
+        ref = tasks.run(self._cfg(workdir, ref_dir, steps_per_loop=8,
+                                  save_checkpoints_steps=0))
+        assert ref["steps"] == 24
+
+        crash_dir = str(workdir / "ckpt_crash_k8")
+        cfg = self._cfg(workdir, crash_dir, steps_per_loop=8,
+                        save_checkpoints_steps=8)
+
+        class CrashAt:
+            def __init__(self, *a, **k):
+                self.n = 0
+
+            def on_step(self, steps_done=1):
+                self.n += steps_done
+                if self.n >= 10:
+                    raise RuntimeError("simulated preemption")
+
+            def close(self):
+                pass
+
+        orig_tracer = prof_lib.StepWindowTracer
+        monkeypatch.setattr(tasks.prof_lib, "StepWindowTracer", CrashAt)
+        with pytest.raises(RuntimeError, match="preemption"):
+            tasks.run(cfg)
+        monkeypatch.setattr(tasks.prof_lib, "StepWindowTracer", orig_tracer)
+
+        meta = tasks._read_resume_meta(crash_dir)
+        assert meta["step"] == 8 and meta["steps_into_epoch"] == 8
+
+        result = tasks.run(self._cfg(workdir, crash_dir, steps_per_loop=8,
+                                     save_checkpoints_steps=8))
+        assert result["steps"] == 24
+
+        # Compare final weights: restore both checkpoints and diff.
+        from deepfm_tpu.train import Trainer
+        ref_state = ckpt_lib.CheckpointManager(ref_dir).restore(
+            Trainer(self._cfg(workdir, ref_dir)).init_state())
+        res_state = ckpt_lib.CheckpointManager(crash_dir).restore(
+            Trainer(self._cfg(workdir, crash_dir)).init_state())
+        for key in ("fm_w", "fm_v", "fm_b"):
+            np.testing.assert_allclose(
+                np.asarray(ref_state.params[key]),
+                np.asarray(res_state.params[key]), rtol=1e-6, atol=1e-7,
+                err_msg=key)
+
+    def test_layout_mismatch_falls_back(self, workdir, monkeypatch):
+        """A resume with a different consumption layout (steps_per_loop)
+        must NOT attempt a mid-epoch skip (the k-pooled orders differ) —
+        it degrades to a fresh invocation with advanced epoch_base."""
+        model_dir = str(workdir / "ckpt_layout")
+        cfg = self._cfg(workdir, model_dir, steps_per_loop=8,
+                        save_checkpoints_steps=8)
+        from deepfm_tpu.utils import profiling as prof_lib
+
+        class CrashAt:
+            def __init__(self, *a, **k):
+                self.n = 0
+
+            def on_step(self, steps_done=1):
+                self.n += steps_done
+                if self.n >= 10:
+                    raise RuntimeError("simulated preemption")
+
+            def close(self):
+                pass
+
+        orig_tracer = prof_lib.StepWindowTracer
+        monkeypatch.setattr(tasks.prof_lib, "StepWindowTracer", CrashAt)
+        with pytest.raises(RuntimeError, match="preemption"):
+            tasks.run(cfg)
+        monkeypatch.setattr(tasks.prof_lib, "StepWindowTracer", orig_tracer)
+
+        # Resume with steps_per_loop=1: layout differs -> fresh 2 epochs
+        # from step 8 (epoch-replay fallback), not a mid-epoch skip.
+        result = tasks.run(self._cfg(workdir, model_dir, steps_per_loop=1))
+        assert result["steps"] == 8 + 24
+
+    def test_pipe_mode_resume_exact(self, workdir, monkeypatch):
+        """Streaming resume: position is steps into the single-pass stream
+        (epochs are producer-side); the trained prefix is skipped."""
+        from deepfm_tpu.utils import profiling as prof_lib
+
+        model_dir = str(workdir / "ckpt_preempt_pipe")
+        cfg = self._cfg(workdir, model_dir, pipe_mode=1)
+
+        class CrashAt:
+            def __init__(self, *a, **k):
+                self.n = 0
+
+            def on_step(self, steps_done=1):
+                self.n += steps_done
+                if self.n >= 7:
+                    raise RuntimeError("simulated preemption")
+
+            def close(self):
+                pass
+
+        orig_tracer = prof_lib.StepWindowTracer
+        monkeypatch.setattr(tasks.prof_lib, "StepWindowTracer", CrashAt)
+        with pytest.raises(RuntimeError, match="preemption"):
+            tasks.run(cfg)
+        monkeypatch.setattr(tasks.prof_lib, "StepWindowTracer", orig_tracer)
+
+        meta = tasks._read_resume_meta(model_dir)
+        assert meta["step"] == 5 and meta["pipe_mode"] == 1
+        result = tasks.run(self._cfg(workdir, model_dir, pipe_mode=1))
+        assert result["steps"] == 2 * (3 * 256 // 64)
+
+    def test_stale_meta_ignored(self, workdir):
+        """A sidecar whose step doesn't match the restored checkpoint (e.g.
+        a lost async save) must be ignored -> epoch-replay fallback."""
+        model_dir = str(workdir / "ckpt_stale")
+        cfg = self._cfg(workdir, model_dir, num_epochs=1)
+        tasks.run(cfg)  # completes: ckpt at step 12, meta completed
+        tasks._write_resume_meta(model_dir, {
+            "step": 999, "epoch": 0, "steps_into_epoch": 3, "epoch_base": 0,
+            "num_epochs": 1, "pipe_mode": 0, "completed": False})
+        result = tasks.run(self._cfg(workdir, model_dir, num_epochs=1))
+        assert result["steps"] == 2 * (3 * 256 // 64)  # full extra epoch
+
+
 class TestChannelWiring:
     """Per-rank channel resolution (reference 2-hvd-gpu/...py:376-380,403:
     SM_CHANNELS sorted eval-first; multi_path = one private training channel
