@@ -180,7 +180,7 @@ def _eval_pipeline(cfg: Config, va_files: List[str]) -> pipe_lib.CtrPipeline:
 
 
 def make_streaming_pipeline(cfg: Config, files: List[str], *, epochs: int = 1,
-                            skip_batches: int = 0
+                            skip_batches: int = 0, epoch_offset: int = 0
                             ) -> pipe_lib.StreamingCtrPipeline:
     """Pipe-mode analog (``--pipe_mode 1``): one sequential single-pass
     stream over this process's file shard, epochs replayed producer-side
@@ -190,7 +190,8 @@ def make_streaming_pipeline(cfg: Config, files: List[str], *, epochs: int = 1,
     shard = _shard_spec(cfg, files)
     stream = pipe_lib.ChainedFileStream(
         list(shard.files), num_epochs=epochs,
-        shuffle_each_epoch=cfg.shuffle_files, seed=cfg.seed)
+        shuffle_each_epoch=cfg.shuffle_files, seed=cfg.seed,
+        epoch_offset=epoch_offset)
     return pipe_lib.StreamingCtrPipeline(
         stream,
         field_size=cfg.field_size,
@@ -347,11 +348,13 @@ def _read_resume_meta(model_dir: str) -> Optional[Dict]:
 
 def _consumption_layout(cfg: Config) -> List[int]:
     """Fingerprint of HOW batches are consumed. The pooled emission order
-    depends on it (k-group drains vs per-batch drains, per-rank sharding),
-    so a mid-epoch skip is only exact when the resuming run consumes the
-    same way the interrupted run did."""
+    and geometry depend on all of these (k-group vs per-batch drains,
+    per-rank sharding, batch/pool sizes, shuffle seed), so a mid-epoch skip
+    is only exact when the resuming run consumes exactly the way the
+    interrupted run did; any difference falls back to epoch-replay."""
     return [jax.process_count(), cfg.steps_per_loop,
-            int(cfg.use_native_decoder)]
+            int(cfg.use_native_decoder), cfg.batch_size,
+            cfg.shuffle_buffer, cfg.seed, int(cfg.drop_remainder)]
 
 
 def _resume_position(cfg: Config, restored_step: int
@@ -366,8 +369,14 @@ def _resume_position(cfg: Config, restored_step: int
     interrupted invocation with the same num_epochs/pipe_mode resumes
     mid-epoch, skipping the batches already trained."""
     meta = _read_resume_meta(cfg.model_dir) if cfg.model_dir else None
-    if not meta or not restored_step or meta.get("step") != restored_step:
+    if not meta or not restored_step:
         return 0, 0, 0
+    if meta.get("step") != restored_step:
+        # Stale sidecar (e.g. a lost async save): the position is unusable,
+        # but the epoch_base is still valid knowledge — keep advancing the
+        # shuffle seeds past every epoch any prior invocation touched.
+        return (int(meta.get("epoch_base", 0)) + int(meta.get("epoch", 0)) + 1,
+                0, 0)
     if meta.get("completed"):
         return (int(meta.get("epoch_base", 0)) + int(meta.get("num_epochs", 0)),
                 0, 0)
@@ -436,6 +445,7 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
 
     try:
         hooks = []
+        last_saved = [-1]
         if mgr is not None:
             # Host-side step counter: reading s.step would force a device
             # sync every step (it blocks on the async-dispatched update),
@@ -446,6 +456,7 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                 step_counter[0] += int(m.get("steps_done", 1))
                 if mgr.should_save(step_counter[0]):
                     if mgr.save(step_counter[0], s):
+                        last_saved[0] = step_counter[0]
                         _write_resume_meta(
                             cfg.model_dir, _meta(step_counter[0], False))
             hooks.append(ckpt_hook)
@@ -466,10 +477,12 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                 # (epoch index stays 0 — position is steps into the stream).
                 pipeline = make_streaming_pipeline(
                     cfg, tr_files, epochs=cfg.num_epochs,
-                    skip_batches=skip_batches)
+                    skip_batches=skip_batches, epoch_offset=epoch_base)
                 state, fit_m = trainer.fit(state, pipeline, hooks=hooks)
-                result["loss"] = fit_m["loss"]
-                result["examples_per_sec"] = fit_m.get("examples_per_sec", 0.0)
+                if fit_m["steps"]:
+                    result["loss"] = fit_m["loss"]
+                    result["examples_per_sec"] = fit_m.get(
+                        "examples_per_sec", 0.0)
                 if va_files:
                     ev = trainer.evaluate(
                         state, _eval_pipeline(cfg, va_files))
@@ -496,9 +509,21 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                         skip_batches=(skip_batches if epoch == start_epoch
                                       else 0))
                     state, fit_m = trainer.fit(state, pipeline, hooks=hooks)
-                    result["loss"] = fit_m["loss"]
-                    result["examples_per_sec"] = fit_m.get(
-                        "examples_per_sec", 0.0)
+                    if fit_m["steps"]:
+                        # (a fully-skipped resumed epoch reports no loss)
+                        result["loss"] = fit_m["loss"]
+                        result["examples_per_sec"] = fit_m.get(
+                            "examples_per_sec", 0.0)
+                    if (mgr is not None and last_saved[0] == step_counter[0]
+                            and epoch + 1 < cfg.num_epochs):
+                        # A checkpoint landed exactly on this epoch's last
+                        # step: roll the sidecar to the next epoch so resume
+                        # starts there instead of decode-skipping a fully
+                        # trained epoch.
+                        progress["epoch"] = epoch + 1
+                        progress["epoch_start"] = step_counter[0]
+                        _write_resume_meta(
+                            cfg.model_dir, _meta(step_counter[0], False))
                     if va_files and not eval_throttled:
                         ev = trainer.evaluate(
                             state, _eval_pipeline(cfg, va_files))

@@ -401,6 +401,43 @@ class TestPipeline:
         assert len(seen) == 150
         assert len(set(seen)) == len(seen)  # disjoint coverage
 
+    def test_streaming_superbatches_match_batches(self, data_dir):
+        """Streaming iter_superbatches yields the identical batch sequence
+        as __iter__ (stream order, no shuffle) — only the grouping differs —
+        and honors single-pass FIFO semantics."""
+        files = self._files(data_dir)
+        raw = b"".join(open(f, "rb").read() for f in files)
+        singles = list(pipeline.StreamingCtrPipeline(
+            io.BytesIO(raw), field_size=6, batch_size=25,
+            prefetch_batches=0, drop_remainder=False))
+        sp = pipeline.StreamingCtrPipeline(
+            io.BytesIO(raw), field_size=6, batch_size=25,
+            prefetch_batches=0, drop_remainder=False)
+        total_m, rows_all = 0, []
+        for rows, m, n_ex in sp.iter_superbatches(3):
+            assert rows["feat_ids"].shape[0] == n_ex
+            total_m += m
+            rows_all.append(rows["feat_ids"])
+        assert total_m == len(singles)
+        np.testing.assert_array_equal(
+            np.concatenate([b["feat_ids"] for b in singles]),
+            np.concatenate(rows_all))
+        with pytest.raises(RuntimeError):  # FIFO: no second pass
+            next(iter(sp.iter_superbatches(3)))
+
+    def test_streaming_skip_batches(self, data_dir):
+        """Resume skip drops exactly the leading batches of the stream."""
+        files = self._files(data_dir)
+        raw = b"".join(open(f, "rb").read() for f in files)
+        full = list(pipeline.StreamingCtrPipeline(
+            io.BytesIO(raw), field_size=6, batch_size=25, prefetch_batches=0))
+        skipped = list(pipeline.StreamingCtrPipeline(
+            io.BytesIO(raw), field_size=6, batch_size=25, prefetch_batches=0,
+            skip_batches=2))
+        assert len(skipped) == len(full) - 2
+        np.testing.assert_array_equal(
+            full[2]["feat_ids"], skipped[0]["feat_ids"])
+
     def test_streaming_single_pass(self, data_dir):
         files = self._files(data_dir)
         raw = b"".join(open(f, "rb").read() for f in files)

@@ -265,7 +265,8 @@ class TestStepAccurateResume:
         meta = tasks._read_resume_meta(model_dir)
         assert meta == {"step": 5, "epoch": 0, "steps_into_epoch": 5,
                         "epoch_base": 0, "num_epochs": 2, "pipe_mode": 0,
-                        "layout": [1, 1, 1], "completed": False}
+                        "layout": tasks._consumption_layout(cfg),
+                        "completed": False}
 
         # Resume the SAME invocation: restores step 5, skips the 5 trained
         # batches of epoch 0, finishes epoch 0 + epoch 1 -> exactly 2 epochs
@@ -340,6 +341,41 @@ class TestStepAccurateResume:
                 np.asarray(ref_state.params[key]),
                 np.asarray(res_state.params[key]), rtol=1e-6, atol=1e-7,
                 err_msg=key)
+
+    def test_epoch_boundary_checkpoint_rolls_over(self, workdir, monkeypatch):
+        """A checkpoint landing exactly on an epoch's last step rolls the
+        sidecar to the next epoch, so resume starts there instead of
+        decode-skipping 100% of a trained epoch (and a zero-step fit)."""
+        from deepfm_tpu.utils import profiling as prof_lib
+
+        model_dir = str(workdir / "ckpt_boundary")
+        cfg = self._cfg(workdir, model_dir, save_checkpoints_steps=4)
+
+        class CrashAt:
+            def __init__(self, *a, **k):
+                self.n = 0
+
+            def on_step(self, steps_done=1):
+                self.n += steps_done
+                if self.n >= 14:  # epoch 1, before its first save at 16
+                    raise RuntimeError("simulated preemption")
+
+            def close(self):
+                pass
+
+        orig_tracer = prof_lib.StepWindowTracer
+        monkeypatch.setattr(tasks.prof_lib, "StepWindowTracer", CrashAt)
+        with pytest.raises(RuntimeError, match="preemption"):
+            tasks.run(cfg)
+        monkeypatch.setattr(tasks.prof_lib, "StepWindowTracer", orig_tracer)
+
+        meta = tasks._read_resume_meta(model_dir)
+        # saved at 12 == epoch-0 end -> sidecar rolled to epoch 1, offset 0
+        assert (meta["step"], meta["epoch"], meta["steps_into_epoch"]) \
+            == (12, 1, 0)
+        result = tasks.run(self._cfg(workdir, model_dir,
+                                     save_checkpoints_steps=4))
+        assert result["steps"] == 24
 
     def test_layout_mismatch_falls_back(self, workdir, monkeypatch):
         """A resume with a different consumption layout (steps_per_loop)
