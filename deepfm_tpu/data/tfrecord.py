@@ -80,14 +80,19 @@ def masked_crc32c(data: bytes) -> int:
 
 
 class TFRecordWriter:
-    """Append serialized records to a TFRecord file (writer side of X4)."""
+    """Append serialized records to a TFRecord file (writer side of X4).
+    Local paths or object-store URLs (``gs://``) via the fileio seam."""
 
     def __init__(self, path: str):
+        from . import fileio  # noqa: PLC0415 (avoid import cycle at load)
         self._path = path
-        parent = os.path.dirname(path)
-        if parent:
-            os.makedirs(parent, exist_ok=True)
-        self._f: Optional[BinaryIO] = open(path, "wb")
+        if fileio.is_remote(path):
+            self._f: Optional[BinaryIO] = fileio.open_stream(path, "wb")
+        else:
+            parent = os.path.dirname(path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            self._f = open(path, "wb")
 
     def write(self, record: bytes) -> None:
         assert self._f is not None, "writer closed"

@@ -352,9 +352,14 @@ def _consumption_layout(cfg: Config) -> List[int]:
     per-rank sharding, batch/pool sizes, shuffle seed), so a mid-epoch skip
     is only exact when the resuming run consumes exactly the way the
     interrupted run did; any difference falls back to epoch-replay."""
-    return [jax.process_count(), cfg.steps_per_loop,
+    # Leading element is a PIPELINE FORMAT VERSION: bump it whenever the
+    # emission order for identical config changes (e.g. the r3 scatter
+    # permutation), so a resume across framework versions falls back to
+    # epoch-replay instead of silently mis-skipping.
+    return [2, jax.process_count(), cfg.steps_per_loop,
             int(cfg.use_native_decoder), cfg.batch_size,
-            cfg.shuffle_buffer, cfg.seed, int(cfg.drop_remainder)]
+            cfg.shuffle_buffer, cfg.seed, int(cfg.drop_remainder),
+            int(cfg.shuffle_files)]
 
 
 def _resume_position(cfg: Config, restored_step: int
@@ -374,9 +379,13 @@ def _resume_position(cfg: Config, restored_step: int
     if meta.get("step") != restored_step:
         # Stale sidecar (e.g. a lost async save): the position is unusable,
         # but the epoch_base is still valid knowledge — keep advancing the
-        # shuffle seeds past every epoch any prior invocation touched.
-        return (int(meta.get("epoch_base", 0)) + int(meta.get("epoch", 0)) + 1,
-                0, 0)
+        # shuffle seeds past every epoch any prior invocation touched. A
+        # pipe-mode meta always records epoch 0 (position is steps into the
+        # stream) while the producer may have replayed up to num_epochs
+        # orders, so advance by the full epoch budget there.
+        touched = (int(meta.get("num_epochs", 0)) if meta.get("pipe_mode")
+                   else int(meta.get("epoch", 0)) + 1)
+        return int(meta.get("epoch_base", 0)) + touched, 0, 0
     if meta.get("completed"):
         return (int(meta.get("epoch_base", 0)) + int(meta.get("num_epochs", 0)),
                 0, 0)

@@ -186,6 +186,19 @@ class TestExportRemoteSeam:
         assert meta["signature"]["inputs"]["feat_ids"] == ["batch", 5, "int32"]
 
 
+class TestWriterRemoteSeam:
+    def test_tfrecord_writer_remote(self, fake_store):
+        """Converter output can target the object store directly (the
+        reference uploaded converter output to S3 out-of-band)."""
+        from deepfm_tpu.data import tfrecord
+        with tfrecord.TFRecordWriter("mock://bucket/out/tr.tfrecords") as w:
+            w.write(b"hello")
+            w.write(b"world")
+        recs = list(tfrecord.iter_records(
+            "mock://bucket/out/tr.tfrecords", verify_crc=True))
+        assert recs == [b"hello", b"world"]
+
+
 class TestInferRemoteSeam:
     def test_infer_reads_and_writes_remote(self, fake_store, tmp_path):
         """End-to-end: te*.tfrecords live in the (fake) object store, the
