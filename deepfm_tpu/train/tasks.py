@@ -376,27 +376,27 @@ def _resume_position(cfg: Config, restored_step: int
     meta = _read_resume_meta(cfg.model_dir) if cfg.model_dir else None
     if not meta or not restored_step:
         return 0, 0, 0
+    base = int(meta.get("epoch_base", 0))
+    # Epochs whose shuffle order the recorded invocation may have touched.
+    # A pipe-mode meta always records epoch 0 (its position is steps into
+    # the stream) while the producer may have replayed up to num_epochs
+    # orders, so count the full epoch budget there.
+    touched = (int(meta.get("num_epochs", 0)) if meta.get("pipe_mode")
+               else int(meta.get("epoch", 0)) + 1)
     if meta.get("step") != restored_step:
         # Stale sidecar (e.g. a lost async save): the position is unusable,
         # but the epoch_base is still valid knowledge — keep advancing the
-        # shuffle seeds past every epoch any prior invocation touched. A
-        # pipe-mode meta always records epoch 0 (position is steps into the
-        # stream) while the producer may have replayed up to num_epochs
-        # orders, so advance by the full epoch budget there.
-        touched = (int(meta.get("num_epochs", 0)) if meta.get("pipe_mode")
-                   else int(meta.get("epoch", 0)) + 1)
-        return int(meta.get("epoch_base", 0)) + touched, 0, 0
+        # shuffle seeds past every epoch any prior invocation touched.
+        return base + touched, 0, 0
     if meta.get("completed"):
-        return (int(meta.get("epoch_base", 0)) + int(meta.get("num_epochs", 0)),
-                0, 0)
+        return base + int(meta.get("num_epochs", 0)), 0, 0
     if (int(meta.get("num_epochs", -1)) == cfg.num_epochs
             and bool(meta.get("pipe_mode")) == bool(cfg.pipe_mode)
             and meta.get("layout") == _consumption_layout(cfg)):
-        return (int(meta.get("epoch_base", 0)), int(meta.get("epoch", 0)),
+        return (base, int(meta.get("epoch", 0)),
                 int(meta.get("steps_into_epoch", 0)))
     # Different invocation shape: start a fresh run but keep seeds moving.
-    return (int(meta.get("epoch_base", 0)) + int(meta.get("epoch", 0)) + 1,
-            0, 0)
+    return base + touched, 0, 0
 
 
 def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:

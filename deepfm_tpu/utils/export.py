@@ -32,6 +32,7 @@ from . import logging as ulog
 _SERVING_FILE = "serving_fn.stablehlo"
 _PARAMS_DIR = "params.ckpt"
 _CONFIG_FILE = "model_config.json"
+_SAVEDMODEL_DIR = "saved_model"
 
 
 def _serving_fn(model, cfg: Config) -> Callable:
@@ -87,7 +88,16 @@ def export_serving(model, state, cfg: Config, out_dir: str) -> str:
         with fileio.open_stream(fileio.join(out_dir, _SERVING_FILE), "wb") as f:
             f.write(serialized)
 
-    # 3. Signature/config metadata.
+    # 3. TF SavedModel (optional): the reference's actual serving artifact
+    # (``export_savedmodel`` with the raw feat_ids/feat_vals signature,
+    # ``1-ps-cpu/...py:458-467``) — a user's existing TF-Serving deployment
+    # can load this directly. Emitted via jax2tf when TF is importable;
+    # lowering failures degrade to the StableHLO+params artifact with a
+    # warning, but write failures surface (same policy as the StableHLO
+    # file above).
+    _export_tf_savedmodel(serve, params, model_state, cfg, out_dir)
+
+    # 4. Signature/config metadata.
     meta = {
         "signature": {
             "inputs": {
@@ -104,6 +114,64 @@ def export_serving(model, state, cfg: Config, out_dir: str) -> str:
         json.dump(meta, f, indent=2)
     ulog.info(f"exported servable model to {out_dir}")
     return out_dir
+
+
+def _export_tf_savedmodel(serve: Callable, params, model_state, cfg: Config,
+                          out_dir: str) -> None:
+    """Write ``<out_dir>/saved_model`` loadable by TF Serving / tf.saved_model.
+
+    The serving signature mirrors the reference exactly: inputs
+    ``feat_ids`` int64[None, F] / ``feat_vals`` float32[None, F] (int64 per
+    the reference's raw placeholders, ``1-ps-cpu/...py:458-461``), output
+    ``prob`` float32[None].
+
+    Weights are held as ``tf.Variable``s on the module (the jax2tf
+    deployment pattern), NOT closed over as Python values — closure would
+    freeze the embedding table into GraphDef constants and hit the 2GB
+    proto limit at CTR scale. Lowering/trace failures degrade with a
+    warning; ``tf.saved_model.save`` I/O failures propagate.
+    """
+    try:
+        import tensorflow as tf  # noqa: PLC0415 (lazy, heavy)
+        from jax.experimental import jax2tf  # noqa: PLC0415
+    except ImportError as e:  # pragma: no cover - env without TF
+        ulog.warning(f"TF SavedModel export skipped (no tensorflow: {e})")
+        return
+    try:
+        variables = tf.nest.map_structure(
+            tf.Variable, (params, model_state))
+        tf_fn = jax2tf.convert(
+            lambda pv, ids, vals: serve(pv[0], pv[1], ids, vals),
+            polymorphic_shapes=[None, "(b, _)", "(b, _)"],
+            with_gradient=False)
+        module = tf.Module()
+        module.model_variables = variables  # tracked -> variables shard
+        module.f = tf.function(
+            lambda feat_ids, feat_vals: {
+                "prob": tf_fn(variables, tf.cast(feat_ids, tf.int32),
+                              feat_vals)},
+            input_signature=[
+                tf.TensorSpec([None, cfg.field_size], tf.int64,
+                              name="feat_ids"),
+                tf.TensorSpec([None, cfg.field_size], tf.float32,
+                              name="feat_vals"),
+            ])
+        # Trace now: lowering errors belong to this guard, not to save().
+        concrete = module.f.get_concrete_function()
+    except Exception as e:  # pragma: no cover - TF-version specific
+        ulog.warning(f"TF SavedModel export skipped ({e})")
+        return
+    sm_dir = fileio.join(out_dir, _SAVEDMODEL_DIR)
+    try:
+        tf.saved_model.save(module, sm_dir,
+                            signatures={"serving_default": concrete})
+    except tf.errors.UnimplementedError as e:
+        # Storage scheme TF's filesystem layer doesn't support: a capability
+        # gap, not a transient failure — degrade like a lowering failure.
+        # (Real I/O errors — permissions, 5xx — are other types and raise.)
+        ulog.warning(f"TF SavedModel export skipped (unsupported scheme: {e})")
+        return
+    ulog.info(f"wrote TF SavedModel to {sm_dir}")
 
 
 def load_serving(artifact_dir: str) -> Callable[[np.ndarray, np.ndarray], np.ndarray]:
