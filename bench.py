@@ -9,7 +9,7 @@ feature_size=117581, field_size=39, embedding_size=32, deep_layers 128/64/32,
 global batch 1024, Adam lr 5e-4 — on whatever accelerator JAX exposes (the
 driver runs this on one real TPU chip). Host batches are pre-staged so the
 number isolates transfer+device throughput; disk decode is benched separately
-(~1.2M ex/s on this 1-core host, see BASELINE.md).
+(~1.4M ex/s on this 1-core host, see BASELINE.md).
 
 Also runs an 8-way-DP wiring check on a virtual 8-device CPU mesh (the
 collective layout is identical to real multi-chip; the aggregate ratio it
