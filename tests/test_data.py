@@ -438,6 +438,18 @@ class TestPipeline:
         np.testing.assert_array_equal(
             full[2]["feat_ids"], skipped[0]["feat_ids"])
 
+    def test_skip_batches_beyond_data_yields_nothing(self, data_dir):
+        """Over-skip (resume meta ahead of a shrunken dataset) exhausts
+        cleanly instead of erroring; both emission paths."""
+        p = pipeline.CtrPipeline(
+            self._files(data_dir), field_size=6, batch_size=32,
+            prefetch_batches=0, skip_batches=10_000)
+        assert list(p) == []
+        p = pipeline.CtrPipeline(
+            self._files(data_dir), field_size=6, batch_size=32,
+            prefetch_batches=0, skip_batches=10_000)
+        assert list(p.iter_superbatches(3)) == []
+
     def test_streaming_single_pass(self, data_dir):
         files = self._files(data_dir)
         raw = b"".join(open(f, "rb").read() for f in files)

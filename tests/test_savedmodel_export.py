@@ -46,6 +46,26 @@ def test_savedmodel_exists_and_matches_jax(artifact):
     np.testing.assert_allclose(tf_probs, jax_probs, rtol=1e-5, atol=1e-6)
 
 
+def test_params_only_fallback_matches(artifact, tmp_path):
+    """Deleting serving_fn.stablehlo degrades load_serving to the
+    rebuild-from-config path with identical outputs (the artifact the
+    export writes when platform lowering fails)."""
+    import os
+    import shutil
+    if not os.path.exists(os.path.join(artifact, "serving_fn.stablehlo")):
+        pytest.skip("artifact is already params-only on this platform")
+    degraded = str(tmp_path / "degraded")
+    shutil.copytree(artifact, degraded)
+    os.remove(os.path.join(degraded, "serving_fn.stablehlo"))
+
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, 120, (8, 5)).astype(np.int32)
+    vals = rng.normal(size=(8, 5)).astype(np.float32)
+    full = export_lib.load_serving(artifact)(ids, vals)
+    fb = export_lib.load_serving(degraded)(ids, vals)
+    np.testing.assert_allclose(full, fb, rtol=1e-5, atol=1e-6)
+
+
 def test_savedmodel_batch_polymorphic(artifact):
     tf = pytest.importorskip("tensorflow")
     loaded = tf.saved_model.load(f"{artifact}/saved_model")
