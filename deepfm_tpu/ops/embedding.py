@@ -13,6 +13,7 @@ the local shard and ``ids`` are the (replicated-over-model) global indices.
 
 from __future__ import annotations
 
+import math
 from typing import Optional
 
 import jax
@@ -94,12 +95,21 @@ def sharded_lookup_allgather(local_table: jax.Array, ids: jax.Array,
     return jnp.take(full, ids.astype(jnp.int32), axis=0)
 
 
+# Vocab rows are padded to a multiple of this REGARDLESS of the current
+# mesh, so the table shape — and therefore every checkpoint — is identical
+# across all power-of-two mesh_model layouts up to 64-way. Without a fixed
+# multiple, a checkpoint trained row-sharded (padded to mesh_model) could
+# not restore on a different mesh (eval single-chip, resume after resize).
+_VOCAB_PAD_MULTIPLE = 64
+
+
 def padded_vocab(feature_size: int, num_shards: int) -> int:
-    """Round the vocabulary up so the table divides evenly across shards.
+    """Round the vocabulary up so the table divides evenly across shards AND
+    keeps a mesh-independent shape (see _VOCAB_PAD_MULTIPLE).
 
     Padding rows are zero-initialized and unreachable from real ids, so they
     stay exactly zero under training (zero data gradient; l2 gradient of a
-    zero row is zero)."""
-    if num_shards <= 1:
-        return feature_size
-    return ((feature_size + num_shards - 1) // num_shards) * num_shards
+    zero row is zero). Non-power-of-two shard counts (no TPU topology has
+    them) fall back to lcm-style padding and are self-consistent only."""
+    m = math.lcm(_VOCAB_PAD_MULTIPLE, max(num_shards, 1))
+    return ((feature_size + m - 1) // m) * m

@@ -63,7 +63,20 @@ class CheckpointManager:
         if step is None:
             raise FileNotFoundError(f"no checkpoint found in {self._dir}")
         abstract = jax.tree.map(_as_abstract, state_template)
-        restored = self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+        try:
+            restored = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract))
+        except ValueError as e:
+            if "not compatible with the stored shape" in str(e):
+                raise RuntimeError(
+                    f"checkpoint at {self._dir} (step {step}) has parameter "
+                    f"shapes incompatible with this build: {e}. Most likely "
+                    f"it was saved before the mesh-independent vocab padding "
+                    f"(embedding tables are now padded to a multiple of 64 "
+                    f"regardless of mesh; ops/embedding.py). Re-export the "
+                    f"model from the original build, or start a fresh "
+                    f"model_dir.") from e
+            raise
         ulog.info(f"restored checkpoint step {step} from {self._dir}")
         return restored
 

@@ -111,10 +111,10 @@ class TestDistributedParity:
         _, s1, ev1 = self._run(_cfg(), data_files)
         cfg = _cfg(mesh_data=4, mesh_model=2, feature_size=500)
         tr, s, ev = self._run(cfg, data_files)
-        # padded vocab: compare the real rows only
+        # padded vocab (mesh-independent multiple): compare real rows only
         fm_v = np.asarray(s.params["fm_v"])[:500]
         np.testing.assert_allclose(
-            np.asarray(s1.params["fm_v"]), fm_v, rtol=1e-3, atol=1e-5)
+            np.asarray(s1.params["fm_v"])[:500], fm_v, rtol=1e-3, atol=1e-5)
         assert abs(ev1["auc"] - ev["auc"]) < 5e-3
         # padding rows stay exactly zero
         pad = np.asarray(s.params["fm_v"])[500:]
@@ -127,7 +127,7 @@ class TestDistributedParity:
         _, s1, ev1 = self._run(_cfg(), data_files, steps=6)
         _, s8, ev8 = self._run(cfg, data_files, steps=6)
         np.testing.assert_allclose(
-            np.asarray(s1.params["fm_w"]),
+            np.asarray(s1.params["fm_w"])[:500],
             np.asarray(s8.params["fm_w"])[:500], rtol=1e-3, atol=1e-5)
         assert abs(ev1["loss"] - ev8["loss"]) < 1e-4
 
@@ -172,13 +172,40 @@ class TestDistributedParity:
         assert np.isfinite(ev["loss"])
         assert 0.0 <= ev["auc"] <= 1.0
 
+    def test_checkpoint_portable_across_meshes(self, data_files, tmp_path):
+        """A checkpoint trained row-sharded restores on a DIFFERENT mesh
+        (resize after preemption, single-chip eval of a pod-trained model).
+        Works because vocab padding is a mesh-independent multiple — with
+        per-mesh padding the table shapes would differ and restore fails."""
+        from deepfm_tpu.utils import checkpoint as ckpt_lib
+        cfg42 = _cfg(mesh_data=4, mesh_model=2, feature_size=501)
+        tr42 = Trainer(cfg42)
+        state42, _ = tr42.fit(tr42.init_state(),
+                              _pipeline(cfg42, data_files), max_steps=4)
+        d = str(tmp_path / "x")
+        with ckpt_lib.CheckpointManager(d) as mgr:
+            mgr.save(4, state42)
+        ev42 = tr42.evaluate(state42, _pipeline(cfg42, data_files,
+                                                shuffle=False))
+
+        for mesh_kw in (dict(mesh_data=8, mesh_model=1),
+                        dict(mesh_data=2, mesh_model=4)):
+            cfg2 = _cfg(feature_size=501, **mesh_kw)
+            tr2 = Trainer(cfg2)
+            with ckpt_lib.CheckpointManager(d) as mgr:
+                restored = mgr.restore(tr2.init_state())
+            ev2 = tr2.evaluate(restored, _pipeline(cfg2, data_files,
+                                                   shuffle=False))
+            assert ev2["auc"] == pytest.approx(ev42["auc"], abs=1e-5), mesh_kw
+            assert ev2["loss"] == pytest.approx(ev42["loss"], abs=1e-5), mesh_kw
+
     @pytest.mark.parametrize("opt", ["Adagrad", "Momentum", "ftrl"])
     def test_optimizer_zoo_distributed_parity(self, data_files, opt):
         _, s1, ev1 = self._run(_cfg(optimizer=opt), data_files, steps=6)
         _, s8, ev8 = self._run(_cfg(optimizer=opt, mesh_data=4, mesh_model=2),
                                data_files, steps=6)
         np.testing.assert_allclose(
-            np.asarray(s1.params["fm_v"]),
+            np.asarray(s1.params["fm_v"])[:500],
             np.asarray(s8.params["fm_v"])[:500], rtol=2e-3, atol=1e-5)
         assert abs(ev1["loss"] - ev8["loss"]) < 1e-3
 
