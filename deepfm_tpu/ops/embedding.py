@@ -112,4 +112,25 @@ def padded_vocab(feature_size: int, num_shards: int) -> int:
     zero row is zero). Non-power-of-two shard counts (no TPU topology has
     them) fall back to lcm-style padding and are self-consistent only."""
     m = math.lcm(_VOCAB_PAD_MULTIPLE, max(num_shards, 1))
+    if m != _VOCAB_PAD_MULTIPLE:
+        _warn_mesh_dependent_padding(num_shards)
     return ((feature_size + m - 1) // m) * m
+
+
+def _warn_mesh_dependent_padding(num_shards: int) -> None:
+    """Once-per-process heads-up: shard counts that don't divide 64 make
+    the padding mesh-dependent again, so checkpoints from this mesh won't
+    restore on meshes with a different padding (surface it at save/train
+    time, not as a confusing restore failure later)."""
+    global _pad_warned
+    if _pad_warned:
+        return
+    _pad_warned = True
+    from ..utils import logging as ulog  # noqa: PLC0415 (avoid eager import)
+    ulog.warning(
+        f"mesh_model={num_shards} does not divide {_VOCAB_PAD_MULTIPLE}: "
+        f"embedding padding becomes mesh-dependent and checkpoints from "
+        f"this mesh are NOT portable to meshes with different padding")
+
+
+_pad_warned = False

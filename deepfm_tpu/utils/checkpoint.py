@@ -67,14 +67,15 @@ class CheckpointManager:
             restored = self._mgr.restore(
                 step, args=ocp.args.StandardRestore(abstract))
         except ValueError as e:
-            if "not compatible with the stored shape" in str(e):
+            if "shape" in str(e):
                 raise RuntimeError(
                     f"checkpoint at {self._dir} (step {step}) has parameter "
-                    f"shapes incompatible with this build: {e}. Most likely "
-                    f"it was saved before the mesh-independent vocab padding "
-                    f"(embedding tables are now padded to a multiple of 64 "
-                    f"regardless of mesh; ops/embedding.py). Re-export the "
-                    f"model from the original build, or start a fresh "
+                    f"shapes that do not match this run's config/build: {e}. "
+                    f"Common causes: changed model hyperparameters "
+                    f"(feature_size/embedding_size/deep_layers) while "
+                    f"reusing a model_dir, or a checkpoint saved before the "
+                    f"mesh-independent vocab padding (ops/embedding.py). "
+                    f"Match the original config, or start a fresh "
                     f"model_dir.") from e
             raise
         ulog.info(f"restored checkpoint step {step} from {self._dir}")
