@@ -470,6 +470,30 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                             cfg.model_dir, _meta(step_counter[0], False))
             hooks.append(ckpt_hook)
 
+        # Fault injection (preemption drill): DEEPFM_TPU_FAULT_AFTER_STEPS=N
+        # kills training after >= N optimizer steps, AFTER the checkpoint
+        # hook has run — a deterministic spot-kill for exercising the
+        # resume path end-to-end (the reference had no fault injection;
+        # SURVEY.md §5). Every rank reads the same env via the launcher, so
+        # the crash is cluster-wide like a real slice preemption.
+        fault_raw = os.environ.get("DEEPFM_TPU_FAULT_AFTER_STEPS", "").strip()
+        try:
+            fault_after = int(fault_raw) if fault_raw else 0
+        except ValueError:
+            raise ValueError(
+                f"DEEPFM_TPU_FAULT_AFTER_STEPS must be an integer step "
+                f"count, got {fault_raw!r}") from None
+        if fault_after:
+            fault_count = [0]
+
+            def fault_hook(s: TrainState, m) -> None:
+                fault_count[0] += int(m.get("steps_done", 1))
+                if fault_count[0] >= fault_after:
+                    raise RuntimeError(
+                        f"fault injection: simulated preemption after "
+                        f"{fault_count[0]} steps")
+            hooks.append(fault_hook)
+
         tracer = prof_lib.StepWindowTracer(
             cfg.profile_dir, num_steps=cfg.profile_steps)
         hooks.append(lambda s, m: tracer.on_step(int(m.get("steps_done", 1))))

@@ -67,7 +67,7 @@ class CheckpointManager:
             restored = self._mgr.restore(
                 step, args=ocp.args.StandardRestore(abstract))
         except ValueError as e:
-            if "shape" in str(e):
+            if "not compatible with the stored shape" in str(e):
                 raise RuntimeError(
                     f"checkpoint at {self._dir} (step {step}) has parameter "
                     f"shapes that do not match this run's config/build: {e}. "

@@ -78,12 +78,13 @@ def _base_args(workdir, port):
     ]
 
 
-def _run_two_procs(args, timeout=420):
+def _run_two_procs(args, timeout=420, extra_env=None, expect_fail=False):
     env = dict(
         os.environ,
         JAX_PLATFORMS="cpu",
         XLA_FLAGS="--xla_force_host_platform_device_count=4",
         PYTHONPATH=_REPO,
+        **(extra_env or {}),
     )
     procs = [
         subprocess.Popen(
@@ -100,6 +101,10 @@ def _run_two_procs(args, timeout=420):
             for q in procs:
                 q.kill()
             pytest.fail(f"rank {r} hung (collective deadlock on ragged shards)")
+        if expect_fail:
+            assert p.returncode != 0, f"rank {r} unexpectedly succeeded"
+            results.append(err)
+            continue
         assert p.returncode == 0, f"rank {r} failed:\n{err[-3000:]}"
         line = [ln for ln in out.splitlines() if ln.startswith("{")][-1]
         results.append(json.loads(line))
@@ -173,6 +178,41 @@ def test_ragged_throttled_eval(ragged_workdir):
     # dependent; the invariant is agreement + completion, not the count).
     assert results[0]["auc"] == pytest.approx(results[1]["auc"], abs=1e-6)
     assert results[0]["mid_train_evals"] == results[1]["mid_train_evals"]
+
+
+def test_multiprocess_preemption_resume(ragged_workdir):
+    """Cluster-wide fault injection (DEEPFM_TPU_FAULT_AFTER_STEPS) kills
+    both ranks mid-epoch after an interval checkpoint; rerunning the same
+    invocation resumes step-accurately — on RAGGED shards, so the resume
+    skip count must agree with the min-truncated lockstep schedule."""
+    model_dir = str(ragged_workdir / "ckpt_fault")
+    args = _base_args(ragged_workdir, _free_port()) + [
+        "--task_type", "train",
+        "--model_dir", model_dir,
+        "--num_epochs", "3",
+        "--steps_per_loop", "1",
+        "--save_checkpoints_steps", "2",
+    ]
+    errs = _run_two_procs(
+        args, extra_env={"DEEPFM_TPU_FAULT_AFTER_STEPS": "3"},
+        expect_fail=True)
+    for err in errs:
+        assert "fault injection" in err, err[-1500:]
+    meta = json.load(open(os.path.join(model_dir, "resume_meta.json")))
+    assert meta["step"] == 2 and not meta["completed"]
+
+    # Same invocation, no fault: resumes from step 2, finishes 3 epochs of
+    # the min-truncated schedule (2 steps/epoch on these shards).
+    results = _run_two_procs(
+        _base_args(ragged_workdir, _free_port()) + [
+            "--task_type", "train",
+            "--model_dir", model_dir,
+            "--num_epochs", "3",
+            "--steps_per_loop", "1",
+            "--save_checkpoints_steps", "2",
+        ])
+    assert results[0]["steps"] == 3 * 2
+    assert results[0]["loss"] == pytest.approx(results[1]["loss"], abs=1e-6)
 
 
 def test_ragged_streaming_train(ragged_workdir):
