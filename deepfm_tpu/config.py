@@ -115,6 +115,10 @@ class Config:
     auc_num_thresholds: int = 200     # parity with tf.metrics.auc default
     seed: int = 42
     profile_dir: str = ""             # jax.profiler trace output ('' = disabled)
+    # TensorBoard scalar summaries (loss/examples_per_sec at log_steps
+    # cadence + per-eval AUC), chief-only — the Estimator summary-writer
+    # analog ('' = disabled).
+    tensorboard_dir: str = ""
     profile_steps: int = 20           # steps traced per run (bounded window)
 
     # ------------------------------------------------------------------

@@ -480,6 +480,7 @@ class Trainer:
         *,
         hooks: Optional[list] = None,
         max_steps: Optional[int] = None,
+        on_log: Optional[Callable[[int, float, float], None]] = None,
     ) -> Tuple[TrainState, Dict[str, float]]:
         """Run the train loop over an iterable of host batches.
 
@@ -520,12 +521,16 @@ class Trainer:
             if cfg.log_steps and (n_steps // cfg.log_steps
                                   > prev_steps // cfg.log_steps):
                 loss = float(m["loss"])  # device sync, bounded by log cadence
+                gstep = int(state.step)
                 last_loss = loss
                 dt = time.time() - t0
                 eps = examples_since_log / max(dt, 1e-9)
                 ulog.info(
-                    f"step={int(state.step)} loss={loss:.5f} "
-                    f"examples/sec={eps:,.0f}")
+                    f"step={gstep} loss={loss:.5f} examples/sec={eps:,.0f}")
+                if on_log is not None:
+                    # Same cadence as the log line: loss/step were already
+                    # synced above, so the callback adds no device reads.
+                    on_log(gstep, loss, eps)
                 t0 = time.time()
                 examples_since_log = 0
             if hooks:

@@ -279,7 +279,8 @@ def _eval_check_due(n_dispatch: int) -> bool:
 
 
 def _make_throttled_eval_hook(trainer: Trainer, cfg: Config,
-                              va_files: List[str], result: Dict[str, float]):
+                              va_files: List[str], result: Dict[str, float],
+                              on_eval=None):
     """Mid-train eval hook with TrainSpec/EvalSpec timing semantics
     (start_delay_secs / throttle_secs, reference 1-ps-cpu/...py:440-441).
 
@@ -316,6 +317,8 @@ def _make_throttled_eval_hook(trainer: Trainer, cfg: Config,
         result.update({"auc": ev["auc"], "eval_loss": ev["loss"]})
         ulog.info(f"throttled eval @ step {int(state.step)}: "
                   f"auc={ev['auc']:.5f} loss={ev['loss']:.5f}")
+        if on_eval is not None:
+            on_eval(ev, state)
 
     return hook
 
@@ -399,6 +402,42 @@ def _resume_position(cfg: Config, restored_step: int
     return base + touched, 0, 0
 
 
+class _TensorBoardWriter:
+    """Chief-only TF-summary scalar writer — the Estimator summary-writer
+    analog (the reference emitted loss summaries every ``log_steps``,
+    flag 1-ps-cpu/...py:47). No-op off-chief or when TF is unavailable."""
+
+    def __init__(self, logdir: str):
+        self._writer = None
+        if not logdir or not bootstrap.is_chief():
+            return
+        try:
+            import tensorflow as tf  # noqa: PLC0415 (lazy, heavy)
+            try:
+                # TF must not claim accelerators in the JAX process (JAX
+                # preallocates; a TF CUDA init here could OOM the run).
+                tf.config.set_visible_devices([], "GPU")
+            except Exception:
+                pass
+            self._tf = tf
+            self._writer = tf.summary.create_file_writer(logdir)
+        except ImportError:
+            ulog.warning("tensorboard_dir set but tensorflow unavailable; "
+                         "summaries disabled")
+
+    def scalars(self, step: int, **values: float) -> None:
+        if self._writer is None:
+            return
+        with self._writer.as_default(step=step):
+            for name, v in values.items():
+                self._tf.summary.scalar(name, v)
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
+
 def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
     train_dir, eval_dir = resolve_channel_dirs(cfg)
     tr_files = resolve_files(train_dir, "tr")
@@ -452,6 +491,16 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                 "pipe_mode": int(cfg.pipe_mode),
                 "layout": _consumption_layout(cfg), "completed": completed}
 
+    tb = _TensorBoardWriter(cfg.tensorboard_dir)
+
+    def _tb_log(step: int, loss: float, eps: float) -> None:
+        tb.scalars(step, loss=loss, examples_per_sec=eps)
+
+    def _tb_eval(ev: Dict[str, float], at_state: Optional[TrainState] = None
+                 ) -> None:
+        s = state if at_state is None else at_state
+        tb.scalars(int(s.step), eval_auc=ev["auc"], eval_loss=ev["loss"])
+
     try:
         hooks = []
         last_saved = [-1]
@@ -499,7 +548,7 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
         hooks.append(lambda s, m: tracer.on_step(int(m.get("steps_done", 1))))
         if eval_throttled:
             hooks.append(_make_throttled_eval_hook(trainer, cfg, va_files,
-                                                   result))
+                                                   result, on_eval=_tb_eval))
         try:
             if cfg.pipe_mode:
                 # Streaming (Pipe-mode analog): ONE train call consuming a
@@ -511,7 +560,8 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                 pipeline = make_streaming_pipeline(
                     cfg, tr_files, epochs=cfg.num_epochs,
                     skip_batches=skip_batches, epoch_offset=epoch_base)
-                state, fit_m = trainer.fit(state, pipeline, hooks=hooks)
+                state, fit_m = trainer.fit(state, pipeline, hooks=hooks,
+                                           on_log=_tb_log)
                 if fit_m["steps"]:
                     result["loss"] = fit_m["loss"]
                     result["examples_per_sec"] = fit_m.get(
@@ -522,6 +572,7 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                     ulog.info(f"streaming train done: eval auc={ev['auc']:.5f} "
                               f"loss={ev['loss']:.5f}")
                     result.update({"auc": ev["auc"], "eval_loss": ev["loss"]})
+                    _tb_eval(ev)
             else:
                 for epoch in range(start_epoch, cfg.num_epochs):
                     # Per-epoch loop in the driver, per the reference's
@@ -541,7 +592,8 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                         epoch_offset=epoch_base + epoch,
                         skip_batches=(skip_batches if epoch == start_epoch
                                       else 0))
-                    state, fit_m = trainer.fit(state, pipeline, hooks=hooks)
+                    state, fit_m = trainer.fit(state, pipeline, hooks=hooks,
+                                               on_log=_tb_log)
                     if fit_m["steps"]:
                         # (a fully-skipped resumed epoch reports no loss)
                         result["loss"] = fit_m["loss"]
@@ -565,6 +617,7 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                             f"{ev['auc']:.5f} loss={ev['loss']:.5f}")
                         result.update({"auc": ev["auc"],
                                        "eval_loss": ev["loss"]})
+                        _tb_eval(ev)
                 if va_files and eval_throttled:
                     # Final eval at completion (train_and_evaluate does one).
                     ev = trainer.evaluate(
@@ -572,8 +625,10 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                     ulog.info(f"final eval: auc={ev['auc']:.5f} "
                               f"loss={ev['loss']:.5f}")
                     result.update({"auc": ev["auc"], "eval_loss": ev["loss"]})
+                    _tb_eval(ev)
         finally:
             tracer.close()
+            tb.close()
         if mgr is not None:
             final_step = int(state.step)
             mgr.save(final_step, state, force=True)
