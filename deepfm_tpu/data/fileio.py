@@ -61,6 +61,13 @@ def exists(path: str) -> bool:
     return os.path.exists(path)
 
 
+def size(path: str) -> int:
+    """Byte length of a (possibly remote) file."""
+    if is_remote(path):
+        return int(_gfile().stat(path).length)
+    return os.path.getsize(path)
+
+
 def makedirs(path: str) -> None:
     if is_remote(path):
         _gfile().makedirs(path)

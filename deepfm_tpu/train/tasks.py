@@ -349,6 +349,36 @@ def _read_resume_meta(model_dir: str) -> Optional[Dict]:
         return None
 
 
+def _files_fingerprint(cfg: Config, files: List[str]) -> str:
+    """Digest of WHAT the pipeline would read: the resolved training file
+    list (basenames + byte sizes — robust to moving the directory wholesale,
+    sensitive to any add/remove/rename/rewrite) plus the shard-mapping flags.
+    If either changes between the interrupted run and the resume, the
+    per-epoch shuffle order / per-rank shard assignment changes and a
+    mid-epoch ``skip_batches`` would silently skip the WRONG prefix (records
+    double-trained or never trained) — so ``_resume_position`` requires this
+    digest to match and falls back to epoch-replay otherwise (ADVICE r3).
+
+    Chief-written, but rank-deterministic: every rank derives its shard from
+    the same sorted file list + flags, so list+flags equality implies
+    per-rank assignment equality. Under ``enable_data_multi_path`` the chief
+    only sees its own private channel; the flag itself is in the digest, and
+    sibling-channel edits that keep the chief's channel identical still
+    change that rank's batch count and therefore the restored step."""
+    import hashlib  # noqa: PLC0415
+
+    h = hashlib.sha256()
+    h.update(f"v1|{int(cfg.enable_data_multi_path)}|"
+             f"{int(cfg.enable_s3_shard)}|{cfg.worker_per_host}|".encode())
+    for path in sorted(files):
+        try:
+            n = fileio.size(path)
+        except OSError:
+            n = -1
+        h.update(f"{os.path.basename(path)}:{n}|".encode())
+    return h.hexdigest()[:32]
+
+
 def _consumption_layout(cfg: Config) -> List[int]:
     """Fingerprint of HOW batches are consumed. The pooled emission order
     and geometry depend on all of these (k-group vs per-batch drains,
@@ -365,8 +395,8 @@ def _consumption_layout(cfg: Config) -> List[int]:
             int(cfg.shuffle_files)]
 
 
-def _resume_position(cfg: Config, restored_step: int
-                     ) -> Tuple[int, int, int]:
+def _resume_position(cfg: Config, restored_step: int,
+                     files_digest: str = "") -> Tuple[int, int, int]:
     """(epoch_base, start_epoch, skip_batches) for this invocation.
 
     The sidecar applies only when its ``step`` matches the restored
@@ -395,7 +425,8 @@ def _resume_position(cfg: Config, restored_step: int
         return base + int(meta.get("num_epochs", 0)), 0, 0
     if (int(meta.get("num_epochs", -1)) == cfg.num_epochs
             and bool(meta.get("pipe_mode")) == bool(cfg.pipe_mode)
-            and meta.get("layout") == _consumption_layout(cfg)):
+            and meta.get("layout") == _consumption_layout(cfg)
+            and meta.get("files") == files_digest):
         return (base, int(meta.get("epoch", 0)),
                 int(meta.get("steps_into_epoch", 0)))
     # Different invocation shape: start a fresh run but keep seeds moving.
@@ -463,7 +494,9 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
             save_interval_steps=cfg.save_checkpoints_steps)
     state = _restore_or_init(trainer, cfg, require=False, mgr=mgr)
     restored_step = int(state.step)
-    epoch_base, start_epoch, skip_batches = _resume_position(cfg, restored_step)
+    files_digest = _files_fingerprint(cfg, tr_files)
+    epoch_base, start_epoch, skip_batches = _resume_position(
+        cfg, restored_step, files_digest)
     if start_epoch or skip_batches:
         ulog.info(f"step-accurate resume: epoch {start_epoch} "
                   f"(+{skip_batches} batches already trained), "
@@ -489,7 +522,8 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                 "steps_into_epoch": step - progress["epoch_start"],
                 "epoch_base": epoch_base, "num_epochs": cfg.num_epochs,
                 "pipe_mode": int(cfg.pipe_mode),
-                "layout": _consumption_layout(cfg), "completed": completed}
+                "layout": _consumption_layout(cfg), "files": files_digest,
+                "completed": completed}
 
     tb = _TensorBoardWriter(cfg.tensorboard_dir)
 
