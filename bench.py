@@ -85,6 +85,20 @@ def measure(cfg) -> dict:
         jax.block_until_ready(m["loss"])
         dt = min(dt, time.perf_counter() - t0)
 
+    # Device-only series: the same dispatch loop over PRE-STAGED device
+    # superbatches — no host->device transfer inside the timed window, so
+    # the number excludes most tunnel/host jitter and is the stable
+    # cross-round regression canary for the compiled step itself
+    # (VERDICT r3 #6).
+    sb_dev = [trainer.put_superbatch(g) for g in groups]
+    dt_dev = float("inf")
+    for _ in range(N_TRIALS):
+        t0 = time.perf_counter()
+        for i in range(N_DISPATCH):
+            state, m = step(state, sb_dev[i % 4])
+        jax.block_until_ready(m["loss"])
+        dt_dev = min(dt_dev, time.perf_counter() - t0)
+
     n_examples = N_DISPATCH * K_STEPS * cfg.batch_size
     total_eps = n_examples / dt
     return {
@@ -92,8 +106,63 @@ def measure(cfg) -> dict:
         "total_eps": total_eps,
         "per_chip_eps": total_eps / max(n_dev, 1),
         "ms_per_step": 1000 * dt / (N_DISPATCH * K_STEPS),
+        "device_only_ms_per_step": 1000 * dt_dev / (N_DISPATCH * K_STEPS),
         "loss": float(m["loss"]),
     }
+
+
+def host_stage_series() -> dict:
+    """Tunnel-free host-pipeline series (VERDICT r3 #6): ns/record of the
+    TFRecord frame stage, the full decode-to-arrays stage, and the complete
+    staged pipeline (decode pool + shuffle + batch assembly) on synthetic
+    Criteo-shaped data. Runs entirely on the host CPU — stable across
+    rounds regardless of TPU-tunnel weather, so deltas here are real
+    regressions in the data path, not weather."""
+    import glob as glob_mod
+    import tempfile
+
+    from deepfm_tpu.data import libsvm
+    from deepfm_tpu.data.pipeline import CtrPipeline
+    from deepfm_tpu.native import loader
+
+    out = {}
+    with tempfile.TemporaryDirectory() as d:
+        libsvm.generate_synthetic_ctr(
+            d, num_files=2, examples_per_file=20000,
+            feature_size=117581, field_size=39, prefix="tr", seed=0)
+        files = sorted(glob_mod.glob(os.path.join(d, "tr*.tfrecords")))
+        bufs = [open(f, "rb").read() for f in files]
+        n_records = 2 * 20000
+
+        def best_of(fn, trials=3):
+            best = float("inf")
+            for _ in range(trials):
+                t0 = time.perf_counter()
+                fn()
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        if loader.available():
+            dt = best_of(lambda: [loader.split_frames(b, verify_crc=False)
+                                  for b in bufs])
+            out["frame_ns_per_record"] = round(1e9 * dt / n_records, 1)
+            dt = best_of(lambda: [loader.decode_file_bytes(
+                b, 39, verify_crc=False) for b in bufs])
+            out["decode_ns_per_record"] = round(1e9 * dt / n_records, 1)
+
+        def run_pipeline():
+            pipe = CtrPipeline(
+                files, field_size=39, batch_size=1024, num_epochs=1,
+                shuffle=True, shuffle_files=True, drop_remainder=True,
+                seed=0)
+            n = 0
+            for rows, m, n_ex in pipe.iter_superbatches(K_STEPS):
+                n += n_ex
+            return n
+
+        dt = best_of(run_pipeline)
+        out["staged_pipeline_ns_per_record"] = round(1e9 * dt / n_records, 1)
+    return out
 
 
 def _bench_cfg(batch_size: int = 1024, mesh_data: int = 0):
@@ -146,13 +215,18 @@ def main() -> None:
                 os.path.dirname(os.path.abspath(__file__)),
                 "scripts", "tpu_smoke.py")],
             capture_output=True, text=True, timeout=600)
-        if "SKIP" in smoke.stdout:
-            # Two distinct skip reasons — don't conflate "this host is not a
-            # TPU" with "the kernel doesn't support this shape on a TPU".
-            pallas_smoke = ("skip_not_tpu" if "not tpu" in smoke.stdout
-                            else "skip_unsupported_shape")
-        elif smoke.returncode == 0 and "PASS" in smoke.stdout:
-            pallas_smoke = "pass"
+        # Parse the machine-readable token (the script's last stdout line),
+        # not free-form narration (ADVICE r3: substring matching here was
+        # one stray word away from misclassifying a failure).
+        token = None
+        for ln in smoke.stdout.splitlines():
+            if ln.startswith("TPU_SMOKE_JSON "):
+                try:
+                    token = json.loads(ln[len("TPU_SMOKE_JSON "):])
+                except ValueError:
+                    pass  # truncated token (crash mid-flush) -> fail below
+        if smoke.returncode == 0 and token is not None:
+            pallas_smoke = token["status"]
         else:
             pallas_smoke = "fail"
             print(f"bench: pallas smoke FAILED:\n{smoke.stdout[-1500:]}"
@@ -191,6 +265,12 @@ def main() -> None:
         except (subprocess.TimeoutExpired, OSError) as e:
             print(f"bench: scaling probe error: {e}", file=sys.stderr)
 
+    try:
+        host_series = host_stage_series()
+    except Exception as e:  # never let the canary sink the headline number
+        print(f"bench: host series error: {e}", file=sys.stderr)
+        host_series = {"error": str(e)}
+
     nominal_per_accel_baseline = 250_000.0 / 4.0
     result = {
         "metric": "deepfm_criteo_train_throughput_per_chip",
@@ -199,6 +279,8 @@ def main() -> None:
         "vs_baseline": round(r["per_chip_eps"] / nominal_per_accel_baseline, 3),
         "devices": r["devices"],
         "aggregate_eps": round(r["total_eps"], 1),
+        "device_only_ms_per_step": round(r["device_only_ms_per_step"], 4),
+        "host_series": host_series,
         "pallas_smoke": pallas_smoke,
     }
     if scaling is not None:

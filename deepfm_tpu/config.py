@@ -83,10 +83,12 @@ class Config:
     prefetch_batches: int = 4
     reader_threads: int = 4           # host decode parallelism (MKL/OMP analog)
     use_native_decoder: bool = True   # C++ TFRecord decode path
-    # CRC32C-check every record. Default False for reference parity AND
-    # speed: tf.data.TFRecordDataset does not verify CRCs either (the
-    # reference pipeline never checked), and skipping it buys ~15-20% host
-    # decode throughput on a 1-core host. Flip on for untrusted data.
+    # CRC32C-check every record. Default False for speed: skipping the
+    # check buys ~15-20% host decode throughput on a 1-core host (TUNING.md).
+    # NOTE this is a deliberate parity DEVIATION, not parity: TF's record
+    # reader does verify the length-field CRC (and data CRC unless the
+    # dataset opts out), so the reference pipeline was checking. Flip on
+    # for untrusted or long-haul-transferred data.
     verify_crc: bool = False
     steps_per_loop: int = 8           # optimizer steps per host dispatch (lax.scan)
     transfer_ahead: int = 2           # host->device staging depth (batches ahead)

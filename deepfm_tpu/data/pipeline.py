@@ -189,7 +189,7 @@ class CtrPipeline:
         prefetch_batches: int = 4,
         use_native_decoder: bool = True,
         reader_threads: int = 4,
-        verify_crc: bool = False,  # matches Config/tf.data default; codec fns keep True
+        verify_crc: bool = False,  # speed-over-parity default (see Config); codec fns keep True
         epoch_offset: int = 0,
         skip_batches: int = 0,
     ):
@@ -585,7 +585,7 @@ class StreamingCtrPipeline:
         prefetch_batches: int = 4,
         use_native_decoder: bool = True,
         record_shard: Optional[Tuple[int, int]] = None,
-        verify_crc: bool = False,  # matches Config/tf.data default; codec fns keep True
+        verify_crc: bool = False,  # speed-over-parity default (see Config); codec fns keep True
         skip_batches: int = 0,
     ):
         self.stream = stream

@@ -285,7 +285,7 @@ def _make_throttled_eval_hook(trainer: Trainer, cfg: Config,
     (start_delay_secs / throttle_secs, reference 1-ps-cpu/...py:440-441).
 
     Multi-process safety: dispatch counts are identical across ranks because
-    ``Trainer.fit`` min-truncates ragged shards (``_sync_truncate``), so every
+    ``Trainer.fit`` min-truncates ragged shards (``_stage_multiprocess``), so every
     rank reaches each agreed check dispatch — the chief's clock verdict is
     then broadcast and the eval collective entered (or skipped) in lockstep."""
     import time as _time
@@ -314,7 +314,8 @@ def _make_throttled_eval_hook(trainer: Trainer, cfg: Config,
         ev = trainer.evaluate(
             state, _eval_pipeline(cfg, va_files))
         result["mid_train_evals"] += 1
-        result.update({"auc": ev["auc"], "eval_loss": ev["loss"]})
+        result.update({"auc": ev["auc"], "eval_loss": ev["loss"],
+                       "eval_examples_per_sec": ev["examples_per_sec"]})
         ulog.info(f"throttled eval @ step {int(state.step)}: "
                   f"auc={ev['auc']:.5f} loss={ev['loss']:.5f}")
         if on_eval is not None:
@@ -359,23 +360,40 @@ def _files_fingerprint(cfg: Config, files: List[str]) -> str:
     double-trained or never trained) — so ``_resume_position`` requires this
     digest to match and falls back to epoch-replay otherwise (ADVICE r3).
 
-    Chief-written, but rank-deterministic: every rank derives its shard from
-    the same sorted file list + flags, so list+flags equality implies
-    per-rank assignment equality. Under ``enable_data_multi_path`` the chief
-    only sees its own private channel; the flag itself is in the digest, and
-    sibling-channel edits that keep the chief's channel identical still
-    change that rank's batch count and therefore the restored step."""
+    Computed on the chief only (see ``_task_train``: the resume decision is
+    broadcast, never derived per-rank). Under ``enable_data_multi_path``
+    ``files`` (the chief's own private channel) is ignored and the digest
+    covers EVERY local worker's training channel — SageMaker downloads all
+    channels to every instance (README-EN.md:82), so the chief can resolve
+    its siblings' channels and a sibling-channel edit invalidates the skip
+    even though the chief's channel is unchanged (ADVICE r4 high).
+
+    Stat/resolve failures degrade to a stable sentinel rather than crashing:
+    ``tf.io.gfile`` raises ``tf.errors.OpError`` (an ``Exception``, NOT an
+    ``OSError``) for remote paths, e.g. a file deleted between glob and
+    fingerprint (ADVICE r4 low)."""
     import hashlib  # noqa: PLC0415
 
     h = hashlib.sha256()
     h.update(f"v1|{int(cfg.enable_data_multi_path)}|"
              f"{int(cfg.enable_s3_shard)}|{cfg.worker_per_host}|".encode())
-    for path in sorted(files):
+    if cfg.enable_data_multi_path:
+        tagged = []
+        for r in range(max(cfg.worker_per_host, 1)):
+            try:
+                chan_dir, _ = resolve_channel_dirs(cfg, process_index=r)
+                tagged.extend((f"c{r}", p)
+                              for p in resolve_files(chan_dir, "tr"))
+            except Exception:  # unresolvable sibling channel: stable marker
+                tagged.append((f"c{r}", "<unresolved>"))
+    else:
+        tagged = [("", p) for p in files]
+    for tag, path in sorted(tagged):
         try:
-            n = fileio.size(path)
-        except OSError:
+            n = fileio.size(path) if path != "<unresolved>" else -2
+        except Exception:  # transient stat failure / gfile OpError
             n = -1
-        h.update(f"{os.path.basename(path)}:{n}|".encode())
+        h.update(f"{tag}={os.path.basename(path)}:{n}|".encode())
     return h.hexdigest()[:32]
 
 
@@ -494,9 +512,24 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
             save_interval_steps=cfg.save_checkpoints_steps)
     state = _restore_or_init(trainer, cfg, require=False, mgr=mgr)
     restored_step = int(state.step)
-    files_digest = _files_fingerprint(cfg, tr_files)
-    epoch_base, start_epoch, skip_batches = _resume_position(
-        cfg, restored_step, files_digest)
+    # The resume decision is computed on the CHIEF ONLY and broadcast to all
+    # ranks: a rank deciding from its own filesystem view (transient stat
+    # failure, eventually-consistent object-store metadata, or a multi-path
+    # private channel) could derive a divergent (epoch_base, start_epoch,
+    # skip_batches) and desynchronize the lockstep collectives — a hang or
+    # silent mis-training (ADVICE r4 high+medium). restored_step itself is
+    # rank-consistent (all ranks restore the same global checkpoint).
+    files_digest = (_files_fingerprint(cfg, tr_files)
+                    if bootstrap.is_chief() else "")
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils  # noqa: PLC0415
+        pos = (_resume_position(cfg, restored_step, files_digest)
+               if bootstrap.is_chief() else (0, 0, 0))
+        pos = multihost_utils.broadcast_one_to_all(np.asarray(pos, np.int64))
+        epoch_base, start_epoch, skip_batches = (int(x) for x in pos)
+    else:
+        epoch_base, start_epoch, skip_batches = _resume_position(
+            cfg, restored_step, files_digest)
     if start_epoch or skip_batches:
         ulog.info(f"step-accurate resume: epoch {start_epoch} "
                   f"(+{skip_batches} batches already trained), "
@@ -605,7 +638,9 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                         state, _eval_pipeline(cfg, va_files))
                     ulog.info(f"streaming train done: eval auc={ev['auc']:.5f} "
                               f"loss={ev['loss']:.5f}")
-                    result.update({"auc": ev["auc"], "eval_loss": ev["loss"]})
+                    result.update({"auc": ev["auc"], "eval_loss": ev["loss"],
+                                   "eval_examples_per_sec":
+                                       ev["examples_per_sec"]})
                     _tb_eval(ev)
             else:
                 for epoch in range(start_epoch, cfg.num_epochs):
@@ -649,8 +684,9 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                         ulog.info(
                             f"epoch {epoch + 1}/{cfg.num_epochs}: eval auc="
                             f"{ev['auc']:.5f} loss={ev['loss']:.5f}")
-                        result.update({"auc": ev["auc"],
-                                       "eval_loss": ev["loss"]})
+                        result.update({"auc": ev["auc"], "eval_loss": ev["loss"],
+                                       "eval_examples_per_sec":
+                                           ev["examples_per_sec"]})
                         _tb_eval(ev)
                 if va_files and eval_throttled:
                     # Final eval at completion (train_and_evaluate does one).
@@ -658,7 +694,9 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                         state, _eval_pipeline(cfg, va_files))
                     ulog.info(f"final eval: auc={ev['auc']:.5f} "
                               f"loss={ev['loss']:.5f}")
-                    result.update({"auc": ev["auc"], "eval_loss": ev["loss"]})
+                    result.update({"auc": ev["auc"], "eval_loss": ev["loss"],
+                                   "eval_examples_per_sec":
+                                       ev["examples_per_sec"]})
                     _tb_eval(ev)
         finally:
             tracer.close()
@@ -729,8 +767,16 @@ def _task_infer(trainer: Trainer, cfg: Config) -> Dict[str, float]:
     # than a full counting pre-pass over the data (2x I/O), ranks advance in
     # lockstep rounds (Trainer.lockstep_batches — the same mechanism eval
     # uses); an exhausted rank feeds dummy batches whose output is discarded.
+    # Batches are padded to the compiled shape and STREAMED through
+    # Trainer.predict, which groups steps_per_loop of them into one stacked
+    # transfer + one scanned program (VERDICT r3 #2 — previously one
+    # program per batch). ``real_rows`` records each fed batch's true row
+    # count; predict preserves per-batch yield order, and it only runs
+    # ahead of the consumer by one group, so the list index is always
+    # populated before its output arrives.
     probs: List[np.ndarray] = []
     n_local = 0
+    real_rows: List[int] = []
     if world > 1:
         from jax.experimental import multihost_utils  # noqa: PLC0415
 
@@ -739,23 +785,36 @@ def _task_infer(trainer: Trainer, cfg: Config) -> Dict[str, float]:
         def make_dummy():
             return zero_batch(cfg.field_size, local_bs)
 
-        for batch, real in trainer.lockstep_batches(pipeline, make_dummy):
-            n = batch["label"].shape[0] if real else 0
-            if real and n < local_bs:
-                batch = pad_batch(batch, local_bs)
-            p = next(iter(trainer.predict(state, [batch])))
+        def feed():
+            # Lockstep rounds keep every rank's fed-stream length identical
+            # (dummies where a shard is exhausted), so predict's k-grouping
+            # — and therefore its program sequence — aligns across ranks.
+            for batch, real in trainer.lockstep_batches(pipeline, make_dummy):
+                n = batch["label"].shape[0] if real else 0
+                real_rows.append(n)
+                yield (pad_batch(batch, local_bs)
+                       if real and n < local_bs else batch)
+
+        for i, p in enumerate(trainer.predict(state, feed())):
+            n = real_rows[i]
             if n:
                 probs.append(p[:n])
                 n_local += n
         counts = np.asarray(multihost_utils.process_allgather(
             np.asarray([n_local]))).reshape(-1)
     else:
-        for batch in pipeline:
-            n = batch["label"].shape[0]
+
+        def feed():
+            for batch in pipeline:
+                n = batch["label"].shape[0]
+                real_rows.append(n)
+                yield (pad_batch(batch, local_bs)  # pad tail, trim after
+                       if n < local_bs else batch)
+
+        for i, p in enumerate(trainer.predict(state, feed())):
+            n = real_rows[i]
             n_local += n
-            if n < local_bs:  # pad tail to the compiled shape, trim after
-                batch = pad_batch(batch, local_bs)
-            probs.append(next(iter(trainer.predict(state, [batch])))[:n])
+            probs.append(p[:n])
     local = (np.concatenate(probs) if probs
              else np.zeros((0,), np.float32)).astype(np.float32)
 

@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Measure multi-process transfer/compute overlap (TUNING.md §4 evidence).
+
+Round 4 and earlier forced ``transfer_ahead=0`` under ``world > 1`` —
+host->device staging serialized with step dispatch — because background
+staging would have interleaved collectives nondeterministically across
+ranks. Round 5 restored the overlap (``Trainer._stage_multiprocess``:
+process-local transfers on a staging thread, ALL collectives on the main
+thread). This script measures the before/after on the same 2-process
+topology the distributed tests use: a real ``jax.distributed`` rendezvous
+of 2 OS processes on the CPU backend, training the reference-shaped model.
+
+``--transfer_ahead 0`` reproduces the old serialized behavior;
+``--transfer_ahead 2`` (the default) is the overlapped path. Trials are
+interleaved (A,B,A,B,...) so host weather hits both variants equally;
+best-of-N wins (same methodology as bench.py / BASELINE.md).
+
+Usage: python scripts/bench_multiprocess.py [--trials 3] [--quick]
+Prints one JSON line: {"serialized_eps": ..., "overlapped_eps": ...,
+"overlap_speedup": ...}.
+"""
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_RUNNER = """
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import sys
+from deepfm_tpu.launch import main
+sys.exit(main(sys.argv[1:]))
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def run_once(data_dir: str, model_dir: str, transfer_ahead: int,
+             epochs: int) -> float:
+    """One 2-process training run; returns rank-0 examples_per_sec."""
+    port = _free_port()
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        PYTHONPATH=_REPO,
+    )
+    args = [
+        "--task_type", "train",
+        "--dist_mode", "1",
+        "--num_processes", "2",
+        "--coordinator_address", f"localhost:{port}",
+        "--data_dir", data_dir,
+        "--val_data_dir", "",
+        "--model_dir", model_dir,
+        "--clear_existing_model", "true",
+        "--feature_size", "117581", "--field_size", "39",
+        "--embedding_size", "32", "--deep_layers", "128,64,32",
+        "--dropout", "0.5,0.5,0.5", "--batch_size", "1024",
+        "--num_epochs", str(epochs), "--learning_rate", "5e-4",
+        "--compute_dtype", "bfloat16",
+        "--mesh_data", "2", "--mesh_model", "1",
+        "--log_steps", "0", "--save_checkpoints_steps", "0",
+        "--transfer_ahead", str(transfer_ahead),
+        "--seed", "0",
+    ]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _RUNNER] + args + ["--process_id", str(r)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, cwd=_REPO)
+        for r in range(2)
+    ]
+    outs = []
+    for r, p in enumerate(procs):
+        out, err = p.communicate(timeout=900)
+        if p.returncode != 0:
+            raise RuntimeError(f"rank {r} failed:\n{err[-3000:]}")
+        outs.append(out)
+    line = [ln for ln in outs[0].splitlines() if ln.startswith("{")][-1]
+    return float(json.loads(line)["examples_per_sec"])
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trials", type=int, default=3)
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+
+    from deepfm_tpu.data import libsvm
+
+    n_files, per_file = (4, 2048) if args.quick else (4, 8192)
+    epochs = 1 if args.quick else 2
+    with tempfile.TemporaryDirectory() as root:
+        data = os.path.join(root, "data")
+        libsvm.generate_synthetic_ctr(
+            data, num_files=n_files, examples_per_file=per_file,
+            feature_size=117581, field_size=39, prefix="tr", seed=1)
+
+        best = {0: 0.0, 2: 0.0}
+        for t in range(args.trials):
+            for ahead in (0, 2):  # interleaved: weather hits both equally
+                eps = run_once(data, os.path.join(root, f"m{t}_{ahead}"),
+                               ahead, epochs)
+                best[ahead] = max(best[ahead], eps)
+                print(f"trial {t} transfer_ahead={ahead}: {eps:,.0f} ex/s",
+                      file=sys.stderr)
+
+        print(json.dumps({
+            "topology": "2-process jax.distributed, CPU backend, 1 host core",
+            "serialized_eps": round(best[0], 1),
+            "overlapped_eps": round(best[2], 1),
+            "overlap_speedup": round(best[2] / max(best[0], 1e-9), 3),
+        }))
+
+
+if __name__ == "__main__":
+    main()

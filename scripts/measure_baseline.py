@@ -63,6 +63,11 @@ def run_config(name: str, model: str, data_dir: str, epochs: int,
         "model": model,
         "batch_size": batch_size,
         "examples_per_sec": round(result.get("examples_per_sec", 0.0), 1),
+        # Final-epoch eval rate: programs compiled in epoch 1, so this is
+        # the steady-state scanned eval dispatch (VERDICT r3 #2 criterion:
+        # within ~2x of train at the same batch size).
+        "eval_examples_per_sec": round(
+            result.get("eval_examples_per_sec", 0.0), 1),
         "auc": round(result.get("auc", 0.0), 5),
         "eval_loss": round(result.get("eval_loss", 0.0), 5),
         "steps": result.get("steps"),

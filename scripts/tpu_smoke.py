@@ -8,14 +8,24 @@ backward of the fused FM kernel vs the jnp oracle, with bf16 inputs so the
 bf16-residual path is what's exercised, then one full jitted train step.
 
 Usage: python scripts/tpu_smoke.py   (exit 0 = pass)
+
+The LAST stdout line is a machine-readable token for harnesses
+(``TPU_SMOKE_JSON {"status": ...}`` with status pass / skip_not_tpu /
+skip_unsupported_shape); everything above it is human-oriented narration.
+A failure raises (non-zero exit, no token).
 """
 
+import json
 import os
 import sys
 
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _token(status: str) -> None:
+    print("TPU_SMOKE_JSON " + json.dumps({"status": status}))
 
 
 def main() -> int:
@@ -26,9 +36,11 @@ def main() -> int:
 
     if jax.default_backend() != "tpu":
         print(f"SKIP: backend is {jax.default_backend()!r}, not tpu")
+        _token("skip_not_tpu")
         return 0
     if not pallas_fm.supported(39, 32):
         print("SKIP: compiled kernel unsupported at (39, 32)")
+        _token("skip_unsupported_shape")
         return 0
 
     rng = np.random.default_rng(0)
@@ -74,6 +86,7 @@ def main() -> int:
     assert np.isfinite(loss), loss
     print(f"full train step with pallas kernel: loss={loss:.4f}")
     print("TPU smoke: PASS")
+    _token("pass")
     return 0
 
 

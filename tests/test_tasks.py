@@ -245,6 +245,48 @@ class TestTensorBoardScalars:
         assert len(recs) > 6
 
 
+class TestFilesFingerprint:
+    """The resume-sidecar files digest (tasks._files_fingerprint)."""
+
+    def _make_channels(self, tmp_path, n=2):
+        for i in range(n):
+            libsvm.generate_synthetic_ctr(
+                str(tmp_path / f"train_{i}"), num_files=2,
+                examples_per_file=64, feature_size=300, field_size=5,
+                prefix="tr", seed=10 + i)
+        (tmp_path / "eval").mkdir()
+
+    def test_multipath_rank_invariant_and_covers_siblings(self, tmp_path):
+        self._make_channels(tmp_path)
+        cfg = Config(
+            feature_size=300, field_size=5, data_dir=str(tmp_path),
+            enable_data_multi_path=True, worker_per_host=2,
+            channels='["eval", "train_0", "train_1"]')
+        d_rank0 = tasks._files_fingerprint(cfg, ["rank0-view"])
+        d_rank1 = tasks._files_fingerprint(cfg, ["a", "different", "view"])
+        # Rank-invariant: each rank's own-channel file list is ignored, ALL
+        # local channels are hashed (ADVICE r4 high — per-rank digests
+        # desynchronized the resume decision).
+        assert d_rank0 == d_rank1
+        # Editing a SIBLING channel (one the chief never trains from) must
+        # still invalidate the digest.
+        files = sorted((tmp_path / "train_1").glob("tr*.tfrecords"))
+        files[0].rename(tmp_path / "train_1" / "tr_renamed.tfrecords")
+        assert tasks._files_fingerprint(cfg, ["rank0-view"]) != d_rank0
+
+    def test_tracks_files_arg_and_tolerates_missing(self, tmp_path):
+        self._make_channels(tmp_path, n=1)
+        files = sorted(str(p) for p in (tmp_path / "train_0").glob("*"))
+        cfg = Config(feature_size=300, field_size=5, data_dir=str(tmp_path))
+        d = tasks._files_fingerprint(cfg, files)
+        assert tasks._files_fingerprint(cfg, files) == d
+        assert tasks._files_fingerprint(cfg, files[:-1]) != d
+        # A file that fails to stat degrades to a sentinel (ADVICE r4 low:
+        # gfile raises OpError, not OSError), never crashes startup.
+        assert tasks._files_fingerprint(
+            cfg, files + [str(tmp_path / "nope.tfrecords")]) != d
+
+
 class TestStepAccurateResume:
     """SURVEY hard-part #5: preemption mid-epoch must resume at the exact
     batch, not replay the epoch (the reference punts on this). Simulates a
@@ -290,9 +332,12 @@ class TestStepAccurateResume:
         monkeypatch.setattr(tasks.prof_lib, "StepWindowTracer", orig_tracer)
 
         meta = tasks._read_resume_meta(model_dir)
+        tr_files = tasks.resolve_files(
+            tasks.resolve_channel_dirs(cfg)[0], "tr")
         assert meta == {"step": 5, "epoch": 0, "steps_into_epoch": 5,
                         "epoch_base": 0, "num_epochs": 2, "pipe_mode": 0,
                         "layout": tasks._consumption_layout(cfg),
+                        "files": tasks._files_fingerprint(cfg, tr_files),
                         "completed": False}
 
         # Resume the SAME invocation: restores step 5, skips the 5 trained
@@ -311,6 +356,80 @@ class TestStepAccurateResume:
         assert result["steps"] == 4 * steps_per_epoch
         meta = tasks._read_resume_meta(model_dir)
         assert meta["epoch_base"] == 2
+
+    def _private_data(self, tmp_path):
+        """Function-private data dir — these tests mutate the file list,
+        which must not poison the module-scoped ``workdir`` fixture."""
+        libsvm.generate_synthetic_ctr(
+            str(tmp_path / "data"), num_files=3, examples_per_file=256,
+            feature_size=300, field_size=5, prefix="tr", seed=7)
+        return tmp_path
+
+    def _crash_once(self, monkeypatch, cfg, at_step):
+        """Run cfg until the tracer hook kills it after ``at_step`` steps,
+        then restore the real tracer."""
+        from deepfm_tpu.utils import profiling as prof_lib
+
+        class CrashAt:
+            def __init__(self, *a, **k):
+                self.n = 0
+
+            def on_step(self, steps_done=1):
+                self.n += steps_done
+                if self.n >= at_step:
+                    raise RuntimeError("simulated preemption")
+
+            def close(self):
+                pass
+
+        orig = prof_lib.StepWindowTracer
+        monkeypatch.setattr(tasks.prof_lib, "StepWindowTracer", CrashAt)
+        with pytest.raises(RuntimeError, match="preemption"):
+            tasks.run(cfg)
+        monkeypatch.setattr(tasks.prof_lib, "StepWindowTracer", orig)
+
+    def test_resume_files_changed_replays_epoch(self, tmp_path, monkeypatch):
+        """The files-digest guard (tasks._resume_position): renaming a shard
+        between interruption and resume changes the per-epoch shuffle order
+        and shard assignment, so a mid-epoch skip would skip the WRONG
+        records — the resume must fall back to epoch-replay (the reference's
+        behavior, 1-ps-cpu/...py:434-435) instead of mis-skipping."""
+        workdir = self._private_data(tmp_path)
+        model_dir = str(tmp_path / "ckpt")
+        self._crash_once(monkeypatch, self._cfg(workdir, model_dir), 7)
+        meta = tasks._read_resume_meta(model_dir)
+        assert meta["step"] == 5 and meta["steps_into_epoch"] == 5
+
+        data = tmp_path / "data"
+        files = sorted(data.glob("tr*.tfrecords"))
+        files[0].rename(data / "tr_renamed.tfrecords")
+
+        result = tasks.run(self._cfg(workdir, model_dir))
+        # Epoch-replay: restored step 5 + num_epochs*12 fresh steps. A
+        # (wrong) mid-epoch skip would end at 24.
+        assert result["steps"] == 5 + 24
+        meta = tasks._read_resume_meta(model_dir)
+        assert meta["completed"] is True
+        assert meta["epoch_base"] == 1  # interrupted epoch 0's order burned
+
+    def test_resume_same_files_skips_exactly(self, tmp_path, monkeypatch):
+        """Control for the digest guard: untouched files -> the sidecar
+        matches and the resume mid-epoch-skips (no replay)."""
+        workdir = self._private_data(tmp_path)
+        model_dir = str(tmp_path / "ckpt")
+        self._crash_once(monkeypatch, self._cfg(workdir, model_dir), 7)
+        result = tasks.run(self._cfg(workdir, model_dir))
+        assert result["steps"] == 24  # exactly num_epochs*12, no replay
+
+    def test_resume_layout_change_replays_epoch(self, tmp_path, monkeypatch):
+        """Same files but different consumption geometry (steps_per_loop
+        changes the pooled emission order): the layout fingerprint must
+        force epoch-replay."""
+        workdir = self._private_data(tmp_path)
+        model_dir = str(tmp_path / "ckpt")
+        self._crash_once(monkeypatch, self._cfg(workdir, model_dir), 7)
+        result = tasks.run(self._cfg(workdir, model_dir, steps_per_loop=2))
+        assert result["steps"] == 5 + 24
 
     def test_resume_matches_uninterrupted_run_k8(self, workdir, monkeypatch):
         """Gold-standard exactness under the PRODUCTION config
