@@ -7,6 +7,6 @@ and embedding-table row-sharding over a `jax.sharding.Mesh`, with XLA
 collectives replacing both the gRPC parameter server and NCCL allreduce.
 """
 
-__version__ = "0.3.0"  # round 3
+__version__ = "0.4.0"  # round 5
 
 from .config import Config, parse_args  # noqa: F401
