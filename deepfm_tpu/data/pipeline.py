@@ -60,6 +60,11 @@ def _get_decoder(use_native: bool):
 # per-call framing cost, small enough to keep RSS constant on huge shards.
 _NATIVE_CHUNK_BYTES = 64 << 20
 
+# Minimum records per sub-span when the fused drain decode splits one big
+# chunk across reader threads (below this the spawn overhead beats the win).
+# Module-level so tests can lower it to exercise the split arithmetic.
+_SCATTER_SPLIT_MIN = 4096
+
 
 def _native_loader():
     """The native decoder module, or None when toolchain/build unavailable."""
@@ -231,68 +236,42 @@ class CtrPipeline:
         # k-pooled stream, whose batch order differs past the first drain.
         self.skip_batches = skip_batches
         self._decode = _get_decoder(use_native_decoder)
+        self._scatter_pool = None  # lazy drain-decode executor (see close())
 
     # ------------------------------------------------------------------
     # Vectorized fast path (native decode straight to arrays).
     # ------------------------------------------------------------------
     def _iter_decoded_chunks(self, epoch: int, loader
                              ) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
-        """Per ~64MB chunk: native frame+decode -> (labels, ids, vals) arrays,
-        record-shard applied, rows permuted. No per-record Python anywhere."""
-        files = list(self._files)
-        if self.shuffle_files:
-            np.random.default_rng(self.seed + epoch).shuffle(files)
-        n_seen = 0
-        got_any = False
+        """Per ~64MB chunk: frame + eager decode -> (labels, ids, vals)
+        arrays. Framing, file order, CRC, and shard selection all come from
+        ``_iter_framed_span_chunks`` (the single source shared with the
+        fused path); the record-shard filter is applied to the SPAN arrays
+        before decode, so sharded ranks decode only their own rows. Decode
+        runs on a thread pool (the C decoder releases the GIL, so this
+        scales on real cores) while framing/IO stays on the producer;
+        bounded in-flight depth keeps memory ~threads x chunk; FIFO
+        consumption preserves deterministic chunk order."""
+        def decode(job: Tuple[bytes, np.ndarray, np.ndarray]):
+            buf, offsets, lengths = job
+            return loader.decode_spans(buf, offsets, lengths, self.field_size)
 
-        def jobs() -> Iterator[Tuple[bytes, np.ndarray, np.ndarray, int]]:
-            nonlocal n_seen, got_any
-            for path in files:
-                for buf, offsets, lengths in _iter_framed_chunks(
-                        path, loader, self.verify_crc):
-                    if len(offsets) == 0:
-                        continue
-                    got_any = True
-                    yield buf, offsets, lengths, n_seen
-                    n_seen += len(offsets)
-
-        def decode(job: Tuple[bytes, np.ndarray, np.ndarray, int]):
-            buf, offsets, lengths, base = job
-            labels, ids, vals = loader.decode_spans(
-                buf, offsets, lengths, self.field_size)
-            if self._record_shard is not None:
-                world, rank = self._record_shard
-                keep = (np.arange(base, base + len(labels)) % world) == rank
-                labels, ids, vals = labels[keep], ids[keep], vals[keep]
-            return labels, ids, vals
-
-        # Decode chunks on a thread pool (the C decoder releases the GIL, so
-        # this scales on real cores) while framing/IO stays on the producer.
-        # Bounded in-flight depth keeps memory ~threads x chunk; FIFO
-        # consumption preserves deterministic chunk order.
+        jobs = self._iter_framed_span_chunks(epoch, loader)
         n_threads = self.reader_threads
         if n_threads <= 1:
-            for job in jobs():
-                out = decode(job)
-                if len(out[0]):
-                    yield out
+            for job in jobs:
+                yield decode(job)
         else:
             import collections  # noqa: PLC0415
             from concurrent.futures import ThreadPoolExecutor  # noqa: PLC0415
             with ThreadPoolExecutor(n_threads) as ex:
                 inflight: "collections.deque" = collections.deque()
-                for job in jobs():
+                for job in jobs:
                     inflight.append(ex.submit(decode, job))
                     while len(inflight) >= n_threads + 1:
-                        out = inflight.popleft().result()
-                        if len(out[0]):
-                            yield out
+                        yield inflight.popleft().result()
                 while inflight:
-                    out = inflight.popleft().result()
-                    if len(out[0]):
-                        yield out
-        if not got_any and files:
-            raise IOError(f"no records found in {len(files)} files")
+                    yield inflight.popleft().result()
 
     def _iter_batches_vectorized(self, loader) -> Iterator[Batch]:
         """Pool decoded chunks to >= max(shuffle_buffer, chunk) rows, permute
@@ -301,6 +280,87 @@ class CtrPipeline:
         on large), with zero per-record Python."""
         for rows, _, _ in self._iter_pooled(loader, 1):
             yield rows
+
+    def _iter_framed_span_chunks(self, epoch: int, loader
+                                 ) -> Iterator[Tuple[bytes, np.ndarray,
+                                                     np.ndarray]]:
+        """Frame (+CRC-check) chunks WITHOUT decoding: yields
+        ``(buf, offsets, lengths)`` with the record-shard filter applied to
+        the span index arrays. THE single source of file order, CRC
+        semantics, and shard selection for the pooled paths —
+        ``_iter_decoded_chunks`` consumes this same stream, so the fused
+        (decode-at-drain) and eager-decode emissions cannot drift apart."""
+        files = list(self._files)
+        if self.shuffle_files:
+            np.random.default_rng(self.seed + epoch).shuffle(files)
+        n_seen = 0
+        got_any = False
+        for path in files:
+            for buf, offsets, lengths in _iter_framed_chunks(
+                    path, loader, self.verify_crc):
+                if len(offsets) == 0:
+                    continue
+                got_any = True
+                base = n_seen
+                n_seen += len(offsets)
+                if self._record_shard is not None:
+                    world, rank = self._record_shard
+                    keep = (np.arange(base, base + len(offsets))
+                            % world) == rank
+                    offsets, lengths = offsets[keep], lengths[keep]
+                    if len(offsets) == 0:
+                        continue
+                yield buf, offsets, lengths
+        if not got_any and files:
+            raise IOError(f"no records found in {len(files)} files")
+
+    def _scatter_pool_executor(self):
+        """Persistent drain-decode thread pool (one per pipeline, not one
+        per drain — spawn/join per pool window would recur every
+        shuffle_buffer records). Released by close() / end of iteration."""
+        if self._scatter_pool is None:
+            from concurrent.futures import ThreadPoolExecutor  # noqa: PLC0415
+            self._scatter_pool = ThreadPoolExecutor(self.reader_threads)
+        return self._scatter_pool
+
+    def close(self) -> None:
+        """Release the drain-decode executor. Iteration paths release it
+        in-line when they finish; this covers abandoned iterators (the
+        train loop calls close() on sources it drops mid-stream)."""
+        if self._scatter_pool is not None:
+            self._scatter_pool.shutdown(wait=False)
+            self._scatter_pool = None
+
+    def _scatter_decode_raw(self, loader, raw, perm: np.ndarray, off: int,
+                            labels: np.ndarray, ids: np.ndarray,
+                            vals: np.ndarray) -> None:
+        """Decode every raw span chunk straight into its permuted pool rows
+        (``loader.decode_spans_scatter``). Rows are disjoint across chunks
+        and the C call releases the GIL, so chunks decode on the reader
+        pool when more than one core is available; big single chunks are
+        split into contiguous sub-spans (>= _SCATTER_SPLIT_MIN records
+        each) to fill the pool."""
+        jobs = []
+        for buf, offsets, lengths in raw:
+            m = len(offsets)
+            parts = max(1, min(self.reader_threads, m // _SCATTER_SPLIT_MIN))
+            step = (m + parts - 1) // parts
+            for s in range(0, m, step):
+                e = min(s + step, m)
+                jobs.append((buf, offsets[s:e], lengths[s:e],
+                             perm[off + s:off + e]))
+            off += m
+
+        def run(job):
+            buf, offs, lens, dest = job
+            loader.decode_spans_scatter(
+                buf, offs, lens, self.field_size, dest, labels, ids, vals)
+
+        if len(jobs) <= 1 or self.reader_threads <= 1:
+            for job in jobs:
+                run(job)
+        else:
+            list(self._scatter_pool_executor().map(run, jobs))
 
     def _iter_pooled(self, loader, k: int
                      ) -> Iterator[Tuple[Batch, int, int]]:
@@ -324,27 +384,45 @@ class CtrPipeline:
         from (seed, epoch + epoch_offset) exactly like the record path."""
         bs = self.batch_size
         sb = bs * max(k, 1)
+        # Fused scatter-decode (r5): with shuffle on and the native decoder
+        # available, the proto decode is DEFERRED to drain time and each
+        # record decodes straight into its permuted pool row — one pass per
+        # record instead of decode-then-scatter (two full passes over the
+        # pool; the scatter was ~30% of the staged-path ns/record). The
+        # permutation, chunk arrival order, and rng stream are identical to
+        # the decode-then-scatter path, so the emission is bit-identical
+        # (pinned by TestPooledEmissionGolden) and the resume layout
+        # version is unchanged. Disabled under record-sharding: the fused
+        # pool holds RAW chunk buffers until drain, and with a 1/world
+        # filter those buffers hold ~world x the rows that count toward
+        # pool_target — a world-fold RSS regression; the eager path decodes
+        # (only) the kept rows and frees each buffer immediately.
+        fused = (self.shuffle and loader is not None
+                 and self._record_shard is None
+                 and hasattr(loader, "decode_spans_scatter"))
         for e in range(self.num_epochs):
             epoch = e + self.epoch_offset
             rng = np.random.default_rng(self.seed * 1_000_003 + epoch)
             pool_target = max(self.shuffle_buffer, sb) if self.shuffle else sb
             pend: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+            raw: List[Tuple[bytes, np.ndarray, np.ndarray]] = []
             n_pend = 0
 
             def drain(final: bool) -> Iterator[Tuple[Batch, int, int]]:
-                nonlocal pend, n_pend
-                if self.shuffle and len(pend) > 0:
-                    # Single-scatter permutation: each chunk's rows land at
-                    # their shuffled destinations in ONE preallocated pool
-                    # write (vs concatenate-then-gather = two full copies;
-                    # measured ~1.7x faster on the pool shuffle). Uniform:
-                    # row j goes to position perm[j] of a full permutation.
+                nonlocal pend, raw, n_pend
+                if self.shuffle and n_pend > 0 and (pend or raw):
+                    # Single-scatter permutation: each row lands at its
+                    # shuffled destination in ONE preallocated pool write
+                    # (vs concatenate-then-gather = two full copies).
+                    # Uniform: row j goes to position perm[j] of a full
+                    # permutation. The drain-remainder (pend, already
+                    # decoded) scatters first, then raw chunks decode
+                    # directly to their rows — matching the arrival order
+                    # the permutation indexes.
                     perm = rng.permutation(n_pend)
-                    labels = np.empty((n_pend,), pend[0][0].dtype)
-                    ids = np.empty((n_pend,) + pend[0][1].shape[1:],
-                                   pend[0][1].dtype)
-                    vals = np.empty((n_pend,) + pend[0][2].shape[1:],
-                                    pend[0][2].dtype)
+                    labels = np.empty((n_pend,), np.float32)
+                    ids = np.empty((n_pend, self.field_size), np.int32)
+                    vals = np.empty((n_pend, self.field_size), np.float32)
                     off = 0
                     for lab, idx, val in pend:
                         dest = perm[off:off + len(lab)]
@@ -352,7 +430,11 @@ class CtrPipeline:
                         ids[dest] = idx
                         vals[dest] = val
                         off += len(lab)
+                    if raw:
+                        self._scatter_decode_raw(
+                            loader, raw, perm, off, labels, ids, vals)
                     pend = [(labels, ids, vals)]
+                    raw = []
                 while n_pend >= sb:
                     yield self._assemble_batch(pend, sb), k, sb
                     n_pend -= sb
@@ -364,12 +446,27 @@ class CtrPipeline:
                         yield self._assemble_batch(pend, n_pend), 1, n_pend
                         n_pend = 0
 
-            for chunk in self._iter_decoded_chunks(epoch, loader):
-                pend.append(chunk)
-                n_pend += len(chunk[0])
-                if n_pend >= pool_target:
-                    yield from drain(final=False)
-            yield from drain(final=True)
+            try:
+                if fused:
+                    for span in self._iter_framed_span_chunks(epoch, loader):
+                        raw.append(span)
+                        n_pend += len(span[1])
+                        if n_pend >= pool_target:
+                            yield from drain(final=False)
+                    yield from drain(final=True)
+                else:
+                    for chunk in self._iter_decoded_chunks(epoch, loader):
+                        pend.append(chunk)
+                        n_pend += len(chunk[0])
+                        if n_pend >= pool_target:
+                            yield from drain(final=False)
+                    yield from drain(final=True)
+            finally:
+                # Release the drain-decode executor at epoch end AND on an
+                # abandoned generator (GeneratorExit lands here). Within an
+                # epoch the executor persists across every pool drain; the
+                # one spawn per epoch is noise.
+                self.close()
 
     def iter_superbatches(self, k: int
                           ) -> Iterator[Tuple[Batch, int, int]]:

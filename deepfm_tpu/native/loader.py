@@ -85,6 +85,13 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_long), ctypes.c_long, ctypes.c_long,
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_long)]
+        lib.dfm_decode_ctr_scatter.restype = ctypes.c_long
+        lib.dfm_decode_ctr_scatter.argtypes = [
+            ctypes.POINTER(ctypes.c_ubyte), ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_long), ctypes.c_long, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_long)]
         lib.dfm_crc32c.restype = ctypes.c_uint32
         lib.dfm_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_long]
         _lib = lib
@@ -176,18 +183,60 @@ def decode_spans(buf, offsets: np.ndarray, lengths: np.ndarray,
         vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         ctypes.byref(detail))
     if rc != 0:
-        bad = -rc - 100
-        reasons = {
-            -20: "'label' is not a single float",
-            -21: f"'ids' length != field_size={field_size}",
-            -22: f"'values' length != field_size={field_size}",
-            -23: ("required keys missing — need 'label' plus 'ids'/'values' "
-                  "(reference schema) or 'feat_ids'/'feat_vals' (legacy)"),
-        }
-        reason = reasons.get(detail.value,
-                             f"malformed Example wire data (code {detail.value})")
-        raise ValueError(f"native decode failed at record {bad}: {reason}")
+        raise ValueError(f"native decode failed at record {-rc - 100}: "
+                         f"{_decode_reason(detail.value, field_size)}")
     return labels, ids, vals
+
+
+def _decode_reason(code: int, field_size: int) -> str:
+    """Human-readable reason for a parse_ctr_example error code (shared by
+    every decode entry point)."""
+    reasons = {
+        -20: "'label' is not a single float",
+        -21: f"'ids' length != field_size={field_size}",
+        -22: f"'values' length != field_size={field_size}",
+        -23: ("required keys missing — need 'label' plus 'ids'/'values' "
+              "(reference schema) or 'feat_ids'/'feat_vals' (legacy)"),
+    }
+    return reasons.get(code, f"malformed Example wire data (code {code})")
+
+
+def decode_spans_scatter(buf, offsets: np.ndarray, lengths: np.ndarray,
+                         field_size: int, dest: np.ndarray,
+                         labels: np.ndarray, ids: np.ndarray,
+                         vals: np.ndarray) -> None:
+    """Fused decode + scatter: decode record i of ``buf`` into row
+    ``dest[i]`` of the caller-provided pool arrays (``labels`` [P],
+    ``ids`` [P, field_size] int32, ``vals`` [P, field_size] float32, all
+    C-contiguous). One pass over the records instead of decode-then-scatter
+    (see ``CtrPipeline._iter_pooled_raw``); the caller guarantees every
+    ``dest[i]`` is in bounds and disjoint across concurrent calls (the GIL
+    is released inside the C call, so threads may fill disjoint rows of the
+    same pool in parallel)."""
+    lib = _load()
+    assert lib is not None
+    n = len(offsets)
+    assert labels.flags.c_contiguous and ids.flags.c_contiguous \
+        and vals.flags.c_contiguous
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    lengths = np.ascontiguousarray(lengths, dtype=np.int64)
+    dest = np.ascontiguousarray(dest, dtype=np.int64)
+    detail = ctypes.c_long(0)
+    rc = lib.dfm_decode_ctr_scatter(
+        _as_ubyte_ptr(buf),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        n, field_size,
+        dest.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.byref(detail))
+    if rc != 0:
+        # Index is relative to THIS (possibly sub-span) call, not the chunk.
+        raise ValueError(
+            f"native scatter-decode failed at span-local record {-rc - 100}: "
+            f"{_decode_reason(detail.value, field_size)}")
 
 
 def decode_batch(records: Sequence[bytes], field_size: int

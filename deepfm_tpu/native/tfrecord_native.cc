@@ -339,6 +339,30 @@ long dfm_decode_ctr(const uint8_t* buf, const long* offsets, const long* lengths
                            vals, nullptr);
 }
 
+// Fused decode + shuffle scatter: decode record i straight into row dest[i]
+// of the output arrays (the shuffle pool). Each record's field bytes are
+// written exactly once, at their permuted destination — replacing the
+// decode-then-scatter sequence (two full passes over the pool: one
+// sequential write + one random-access copy) with a single pass whose only
+// random access is the final store. The caller owns destination bounds
+// (every dest[i] < pool rows) and disjointness across concurrent calls.
+long dfm_decode_ctr_scatter(const uint8_t* buf, const long* offsets,
+                            const long* lengths, long n, long field_size,
+                            const long* dest, float* labels, int32_t* ids,
+                            float* vals, long* err_detail) {
+  for (long i = 0; i < n; ++i) {
+    const uint8_t* p = buf + offsets[i];
+    const long d = dest[i];
+    long rc = parse_ctr_example(p, p + lengths[i], field_size, labels + d,
+                                ids + d * field_size, vals + d * field_size);
+    if (rc != 0) {
+      if (err_detail) *err_detail = rc;
+      return -(100 + i);
+    }
+  }
+  return 0;
+}
+
 // Standalone CRC32C for tests.
 uint32_t dfm_crc32c(const uint8_t* data, long len) {
   init_crc_tables();

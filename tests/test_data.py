@@ -740,3 +740,79 @@ class TestReferenceSchemaEndToEnd:
             {"label": (np.asarray([1.0], np.float32), "float")})
         with pytest.raises(ValueError, match="required keys missing"):
             loader.decode_batch([buf], self.F)
+
+
+class TestPooledEmissionGolden:
+    """The pooled emission format is a RESUME COMPATIBILITY contract: a
+    mid-epoch resume decode-skips along this exact stream, so any change to
+    the emission order for identical config silently mis-skips unless the
+    pipeline format version (tasks._consumption_layout) is bumped. These
+    golden hashes pin the byte-exact emission of the native pooled path;
+    they were captured BEFORE the r5 fused scatter-decode landed, proving
+    that rewrite emission-identical. If a deliberate format change breaks
+    them, bump the layout version and re-capture."""
+
+    GOLDEN = {
+        (8, 64, 0, True): "26fff204f1d9b877c88d8696",
+        (4, 32, 5, False): "5130307b96f68f89dadc8fa5",
+        (1, 64, 0, True): "3d50f093770b87683461989f",
+    }
+
+    @pytest.fixture()
+    def golden_files(self, tmp_path):
+        libsvm.generate_synthetic_ctr(
+            str(tmp_path), num_files=3, examples_per_file=500,
+            feature_size=1000, field_size=7, prefix="tr", seed=5)
+        return sorted(str(p) for p in tmp_path.glob("tr*.tfrecords"))
+
+    def _emission_hash(self, files, k, bs, skip, drop, **kw):
+        import hashlib
+        pipe = pipeline.CtrPipeline(
+            files, field_size=7, batch_size=bs, num_epochs=2,
+            shuffle=True, shuffle_files=True, shuffle_buffer=300,
+            drop_remainder=drop, seed=9, skip_batches=skip, **kw)
+        h = hashlib.sha256()
+        for rows, m, n_ex in pipe.iter_superbatches(k):
+            h.update(str(m).encode())
+            h.update(str(n_ex).encode())
+            h.update(rows["feat_ids"].tobytes())
+            h.update(rows["feat_vals"].tobytes())
+            h.update(rows["label"].tobytes())
+        return h.hexdigest()[:24]
+
+    @pytest.mark.skipif(not pipeline._native_loader(),
+                        reason="native decoder unavailable")
+    def test_emission_matches_golden(self, golden_files):
+        for (k, bs, skip, drop), want in self.GOLDEN.items():
+            got = self._emission_hash(golden_files, k, bs, skip, drop)
+            assert got == want, (
+                f"pooled emission changed for (k={k}, bs={bs}, skip={skip}, "
+                f"drop={drop}): {got} != {want} — if deliberate, bump the "
+                f"pipeline format version in tasks._consumption_layout and "
+                f"re-capture")
+
+    @pytest.mark.skipif(not pipeline._native_loader(),
+                        reason="native decoder unavailable")
+    def test_parallel_scatter_decode_identical(self, golden_files,
+                                               monkeypatch):
+        """The multi-threaded drain decode (reader_threads > 1, chunks split
+        into disjoint sub-spans) must emit the same bytes as sequential.
+        reader_threads is core-clamped at __init__, so force it post-init,
+        and lower _SCATTER_SPLIT_MIN so these 500-record chunks actually
+        split — exercising the perm[off+s:off+e] sub-span arithmetic that
+        production 64MB chunks (100k+ records) hit."""
+        import hashlib
+        monkeypatch.setattr(pipeline, "_SCATTER_SPLIT_MIN", 100)
+        pipe = pipeline.CtrPipeline(
+            golden_files, field_size=7, batch_size=64, num_epochs=2,
+            shuffle=True, shuffle_files=True, shuffle_buffer=300,
+            drop_remainder=True, seed=9)
+        pipe.reader_threads = 3
+        h = hashlib.sha256()
+        for rows, m, n_ex in pipe.iter_superbatches(8):
+            h.update(str(m).encode())
+            h.update(str(n_ex).encode())
+            h.update(rows["feat_ids"].tobytes())
+            h.update(rows["feat_vals"].tobytes())
+            h.update(rows["label"].tobytes())
+        assert h.hexdigest()[:24] == self.GOLDEN[(8, 64, 0, True)]

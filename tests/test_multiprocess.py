@@ -173,3 +173,126 @@ def test_fanout_spawns_local_cluster(mp_workdir):
     r1 = json.loads(lines[1].split("] ", 1)[1])
     assert r0["steps"] == 4 * 128 // 64
     assert r0["loss"] == pytest.approx(r1["loss"], abs=1e-6)
+
+
+@pytest.fixture(scope="module")
+def multipath_workdir(tmp_path_factory):
+    """Private-channel layout: eval channel + one training channel per local
+    worker (the hvd enable_data_multi_path contract, README-EN.md:78-84)."""
+    d = tmp_path_factory.mktemp("multipath")
+    for i in range(2):
+        libsvm.generate_synthetic_ctr(
+            str(d / "data" / f"train_{i}"), num_files=2,
+            examples_per_file=64, feature_size=300, field_size=5,
+            prefix="tr", seed=31 + i)
+    libsvm.generate_synthetic_ctr(
+        str(d / "data" / "eval"), num_files=1, examples_per_file=64,
+        feature_size=300, field_size=5, prefix="va", seed=33)
+    return d
+
+
+def _multipath_args(workdir, port, model_dir):
+    return [
+        "--task_type", "train",
+        "--dist_mode", "1",
+        "--num_processes", "2",
+        "--coordinator_address", f"localhost:{port}",
+        "--data_dir", str(workdir / "data"),
+        "--channels", '["eval", "train_0", "train_1"]',
+        "--enable_data_multi_path", "true",
+        "--worker_per_host", "2",
+        "--model_dir", model_dir,
+        "--feature_size", "300", "--field_size", "5",
+        "--embedding_size", "8", "--deep_layers", "16,8",
+        "--dropout", "1.0,1.0", "--batch_size", "64",
+        "--num_epochs", "2", "--learning_rate", "0.05",
+        "--scale_lr_by_world", "false", "--compute_dtype", "float32",
+        "--mesh_data", "2", "--mesh_model", "1",
+        "--log_steps", "0", "--seed", "3",
+        "--steps_per_loop", "1", "--save_checkpoints_steps", "2",
+    ]
+
+
+def _mp_run(args, extra_env=None, expect_fail=False, timeout=420):
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        # One local device per process: the ('data','model') mesh is built
+        # over ALL global devices, so local device count x processes must
+        # equal mesh_data x mesh_model (= 2x1 here).
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        PYTHONPATH=_REPO,
+        **(extra_env or {}),
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _RUNNER] + args + ["--process_id", str(r)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, cwd=_REPO)
+        for r in range(2)
+    ]
+    results = []
+    for r, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail(f"rank {r} hung (resume decision desync?)")
+        if expect_fail:
+            assert p.returncode != 0, f"rank {r} unexpectedly succeeded"
+            results.append(err)
+            continue
+        assert p.returncode == 0, f"rank {r} failed:\n{err[-3000:]}"
+        line = [ln for ln in out.splitlines() if ln.startswith("{")][-1]
+        results.append(json.loads(line))
+    return results
+
+
+def test_multipath_resume_sibling_channel_edit(multipath_workdir):
+    """ADVICE r4 high, behaviorally: under enable_data_multi_path each rank
+    trains its own private channel, so (pre-fix) per-rank files digests
+    diverged and a resume could mid-epoch-skip on the chief while replaying
+    on its sibling — desynchronizing the lockstep collectives. The fix makes
+    the chief hash ALL local channels and broadcast the resume decision.
+
+    Asserts both halves: (a) an untouched resume mid-epoch-skips exactly on
+    every rank; (b) editing a SIBLING channel (one the chief does NOT train
+    from) forces cluster-wide epoch-replay — and neither case hangs.
+
+    Schedule on these shards: 128 records/rank, local batch 32 -> 4
+    steps/epoch; fault after 3 steps with checkpoints every 2 -> restored
+    step 2, 2 steps into epoch 0."""
+    # Crash two training runs identically (separate model dirs so each can
+    # be resumed under a different condition).
+    dirs = {}
+    for tag in ("control", "edited"):
+        model_dir = str(multipath_workdir / f"ckpt_{tag}")
+        dirs[tag] = model_dir
+        errs = _mp_run(
+            _multipath_args(multipath_workdir, _free_port(), model_dir),
+            extra_env={"DEEPFM_TPU_FAULT_AFTER_STEPS": "3"},
+            expect_fail=True)
+        for err in errs:
+            assert "fault injection" in err, err[-1500:]
+        meta = json.load(
+            open(os.path.join(model_dir, "resume_meta.json")))
+        assert meta["step"] == 2 and meta["steps_into_epoch"] == 2
+
+    # (a) Untouched files: exact mid-epoch skip -> 2 epochs x 4 steps.
+    results = _mp_run(
+        _multipath_args(multipath_workdir, _free_port(), dirs["control"]))
+    assert results[0]["steps"] == 2 * 4
+    assert results[0]["loss"] == pytest.approx(results[1]["loss"], abs=1e-6)
+
+    # (b) Rename a shard in train_1 — the CHIEF's own channel (train_0) is
+    # untouched, so a chief-local digest would wrongly match. The all-
+    # channel digest must mismatch -> cluster-wide epoch-replay: restored
+    # step 2 + num_epochs*4 fresh steps.
+    chan = multipath_workdir / "data" / "train_1"
+    victim = sorted(chan.glob("tr*.tfrecords"))[0]
+    victim.rename(chan / "tr_renamed.tfrecords")
+    results = _mp_run(
+        _multipath_args(multipath_workdir, _free_port(), dirs["edited"]))
+    assert results[0]["steps"] == 2 + 2 * 4
+    assert results[0]["loss"] == pytest.approx(results[1]["loss"], abs=1e-6)
