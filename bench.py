@@ -86,10 +86,13 @@ def measure(cfg) -> dict:
         dt = min(dt, time.perf_counter() - t0)
 
     # Device-only series: the same dispatch loop over PRE-STAGED device
-    # superbatches — no host->device transfer inside the timed window, so
-    # the number excludes most tunnel/host jitter and is the stable
-    # cross-round regression canary for the compiled step itself
-    # (VERDICT r3 #6).
+    # superbatches — no bulk host->device data transfer inside the timed
+    # window (VERDICT r3 #6). NOT fully tunnel-free: each dispatch is
+    # still an RPC through the chip tunnel, so congested windows inflate
+    # this series too (measured same-day swings 0.015 -> 3.0 ms/step with
+    # identical code; all blocking modes agree, so it is launch latency,
+    # not under-blocking). Best-of-N picks the clean window; host_series
+    # is the fully tunnel-free canary.
     sb_dev = [trainer.put_superbatch(g) for g in groups]
     dt_dev = float("inf")
     for _ in range(N_TRIALS):
@@ -165,30 +168,90 @@ def host_stage_series() -> dict:
     return out
 
 
-def _bench_cfg(batch_size: int = 1024, mesh_data: int = 0):
+def _bench_cfg(batch_size: int = 1024, mesh_data: int = 0,
+               mesh_model: int = 1, use_pallas: bool = True):
     from deepfm_tpu.config import Config
     return Config(
         feature_size=117581, field_size=39, embedding_size=32,
         deep_layers="128,64,32", dropout="0.5,0.5,0.5",
         batch_size=batch_size, learning_rate=5e-4, optimizer="Adam",
         l2_reg=1e-4, compute_dtype="bfloat16", mesh_data=mesh_data,
-        mesh_model=1, log_steps=0, seed=0, steps_per_loop=K_STEPS)
+        mesh_model=mesh_model, log_steps=0, seed=0, steps_per_loop=K_STEPS,
+        use_pallas=use_pallas)
+
+
+def pallas_ab_device_ratio() -> dict:
+    """Interleaved Pallas-vs-XLA A/B over the device-only staged multi-step
+    (no transfer inside the timed window) — the regression canary for the
+    fused FM kernel. The variants alternate trial-by-trial so tunnel/host
+    weather hits both equally; best-of-N each; the RATIO is the stable
+    series (both numerators ride the same window)."""
+    import jax
+
+    from deepfm_tpu.train import Trainer
+
+    setups = {}
+    for pallas in (True, False):
+        cfg = _bench_cfg(use_pallas=pallas)
+        tr = Trainer(cfg)
+        st = tr.init_state()
+        sb = [tr.put_superbatch(g) for g in _make_groups(cfg, 2)]
+        st, m = tr.multi_step(st, sb[0])  # compile
+        jax.block_until_ready(m["loss"])
+        setups[pallas] = [tr, st, sb]
+    trials = []
+    for _ in range(N_TRIALS):
+        pair = {}
+        for pallas in (True, False):
+            tr, st, sb = setups[pallas]
+            t0 = time.perf_counter()
+            for i in range(N_DISPATCH):
+                st, m = tr.multi_step(st, sb[i % 2])
+            jax.block_until_ready(m["loss"])
+            setups[pallas][1] = st
+            pair[pallas] = time.perf_counter() - t0
+        trials.append(pair)
+    # The ratio is taken WITHIN one trial pair (the cleanest-window pair,
+    # by combined time) — taking each variant's independent best could mix
+    # measurements from different weather windows and report a ratio no
+    # single window ever exhibited.
+    clean = min(trials, key=lambda p: p[True] + p[False])
+    denom = N_DISPATCH * K_STEPS
+    return {
+        "pallas_ms_per_step": round(
+            1000 * min(p[True] for p in trials) / denom, 4),
+        "xla_ms_per_step": round(
+            1000 * min(p[False] for p in trials) / denom, 4),
+        "pallas_over_xla_ratio": round(clean[True] / clean[False], 3),
+    }
 
 
 def scaling_probe() -> None:
-    """--scaling mode (run in a subprocess): 1-dev vs 8-dev DP on a virtual
-    CPU mesh; prints one JSON line with the efficiency."""
+    """--scaling mode (run in a subprocess): 1-dev vs 8-dev DP vs 4x2
+    DP x row-shard on a virtual CPU mesh; prints one JSON line. The value
+    is wiring-level (the collective programs compile and execute over the
+    full mesh, including the masked-gather+psum embedding lookup on the
+    'model' axis); the ratios measure host time-slicing, not hardware."""
     from __graft_entry__ import _provision_virtual_devices
     _provision_virtual_devices(8)
 
     r1 = measure(_bench_cfg(batch_size=1024, mesh_data=1))
     r8 = measure(_bench_cfg(batch_size=8 * 1024, mesh_data=8))
-    eff = r8["total_eps"] / (8 * r1["total_eps"])
-    print(json.dumps({
+    out = {
         "one_dev_eps": round(r1["total_eps"], 1),
         "eight_dev_eps": round(r8["total_eps"], 1),
-        "aggregate_ratio_8v1": round(eff, 3),
-    }))
+        "aggregate_ratio_8v1": round(
+            r8["total_eps"] / (8 * r1["total_eps"]), 3),
+    }
+    # The 4x2 leg must not sink the (older) DP-only signal if it breaks.
+    try:
+        r42 = measure(_bench_cfg(batch_size=4 * 1024, mesh_data=4,
+                                 mesh_model=2))
+        out["dp4_mp2_eps"] = round(r42["total_eps"], 1)
+        out["dp4_mp2_loss_finite"] = bool(np.isfinite(r42["loss"]))
+    except Exception as e:
+        out["dp4_mp2_error"] = str(e)[:300]
+    print(json.dumps(out))
 
 
 def main() -> None:
@@ -271,6 +334,12 @@ def main() -> None:
         print(f"bench: host series error: {e}", file=sys.stderr)
         host_series = {"error": str(e)}
 
+    try:
+        pallas_ab = pallas_ab_device_ratio()
+    except Exception as e:
+        print(f"bench: pallas A/B error: {e}", file=sys.stderr)
+        pallas_ab = {"error": str(e)}
+
     nominal_per_accel_baseline = 250_000.0 / 4.0
     result = {
         "metric": "deepfm_criteo_train_throughput_per_chip",
@@ -281,6 +350,7 @@ def main() -> None:
         "aggregate_eps": round(r["total_eps"], 1),
         "device_only_ms_per_step": round(r["device_only_ms_per_step"], 4),
         "host_series": host_series,
+        "pallas_ab_device": pallas_ab,
         "pallas_smoke": pallas_smoke,
     }
     if scaling is not None:
@@ -288,10 +358,13 @@ def main() -> None:
         # time-slice this host's core(s), so the aggregate ratio mostly
         # measures time-slicing (~1/8 on a 1-core host), not hardware
         # scaling. Its value here is wiring-level: the 8-way DP collective
-        # program compiled and executed. Real scaling needs real chips.
+        # program AND the 4x2 DP x row-shard program (masked-gather+psum
+        # embedding lookup over 'model') compiled and executed. Real
+        # scaling needs real chips.
         result["dp8_virtual_cpu_mesh_check"] = {
             "ok": True,
             "aggregate_ratio_8v1_timeslicing": scaling["aggregate_ratio_8v1"],
+            "dp4_mp2_ok": bool(scaling.get("dp4_mp2_loss_finite", False)),
         }
     print(json.dumps(result))
 
