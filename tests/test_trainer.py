@@ -299,3 +299,53 @@ class TestScannedEvalPredict:
         p4 = np.concatenate(list(tr4.predict(st4, padded(cfg4))))
         assert p1.shape == p4.shape
         np.testing.assert_array_equal(p1, p4)
+
+
+class TestStageMultiprocessProtocol:
+    """Unit pin for the lockstep min-truncate protocol in
+    Trainer._stage_multiprocess (the 2-OS-process tests exercise it for
+    real; this pins the round arithmetic — dispatch exactly min(counts)
+    per round, stop at the first short round, drop local leftovers —
+    against a simulated slower sibling rank, without process spawns)."""
+
+    def _batches(self, n, bs=64, fields=6):
+        rng = np.random.default_rng(0)
+        return [{
+            "feat_ids": rng.integers(0, 500, (bs, fields)).astype(np.int32),
+            "feat_vals": rng.normal(size=(bs, fields)).astype(np.float32),
+            "label": (rng.random((bs, 1)) < 0.3).astype(np.float32),
+        } for _ in range(n)]
+
+    def _run(self, monkeypatch, local_batches, other_counts, k):
+        from jax.experimental import multihost_utils
+
+        tr = Trainer(_cfg(steps_per_loop=k))
+        other = iter(other_counts)
+
+        def fake_allgather(x):
+            mine = int(np.asarray(x).reshape(-1)[0])
+            return np.asarray([[mine], [next(other)]])
+
+        monkeypatch.setattr(multihost_utils, "process_allgather",
+                            fake_allgather)
+        return list(tr._stage_multiprocess(iter(local_batches), k, depth=1))
+
+    def test_truncates_to_global_min_and_stops(self, monkeypatch):
+        # This rank pulls rounds of [2, 2, 1]; the sibling reports [2, 2, 0]:
+        # two full scanned rounds run, the third dispatches min(1,0)=0 and
+        # terminates — the leftover local batch is dropped (cross-rank
+        # drop_remainder), never half-dispatched.
+        out = self._run(monkeypatch, self._batches(5), [2, 2, 0], k=2)
+        assert [steps for _, steps, _ in out] == [2, 2]
+        assert sum(n for _, _, n in out) == 4 * 64
+
+    def test_short_final_round_dispatches_singles(self, monkeypatch):
+        # Both ranks agree the final round is short (min=1 < k): the agreed
+        # prefix re-dispatches as single steps, not a scanned group.
+        out = self._run(monkeypatch, self._batches(3), [2, 1], k=2)
+        assert [steps for _, steps, _ in out] == [2, 1]
+
+    def test_exhausted_rank_stops_everyone(self, monkeypatch):
+        # This rank still has data but the sibling is empty on round 1.
+        out = self._run(monkeypatch, self._batches(4), [0], k=2)
+        assert out == []
