@@ -92,6 +92,12 @@ class Config:
     verify_crc: bool = False
     steps_per_loop: int = 8           # optimizer steps per host dispatch (lax.scan)
     transfer_ahead: int = 2           # host->device staging depth (batches ahead)
+    # ---- fault tolerance (I/O layer; see README "Fault tolerance") ----
+    on_bad_record: str = "raise"      # raise | skip corrupt/truncated records
+    max_bad_records: int = 0          # skip budget when skipping (0 = unlimited)
+    io_retries: int = 4               # attempts per I/O op (1 = no retry)
+    io_retry_backoff_secs: float = 0.1  # base of exponential full-jitter backoff
+    io_retry_deadline_secs: float = 0.0  # per-op wall-clock cap (0 = none)
 
     # ---- mesh / parallelism (replaces TF_CONFIG + horovod knobs) ----
     mesh_data: int = 0                # data-parallel axis size (0 = all devices)
@@ -112,6 +118,10 @@ class Config:
     log_steps: int = 10               # reference flag :47 (value 10 in ipynb:90)
     save_checkpoints_steps: int = 1000
     keep_checkpoint_max: int = 3
+    # Consecutive interval-save failures tolerated before aborting; each
+    # failure logs and defers to the next interval (final forced save
+    # always hard-fails). 0 = fail on the first save error.
+    max_save_failures: int = 3
     eval_start_delay_secs: int = 0    # reference TrainSpec/EvalSpec (1-ps-cpu/...py:440-441)
     eval_throttle_secs: int = 0
     auc_num_thresholds: int = 200     # parity with tf.metrics.auc default
@@ -147,6 +157,18 @@ class Config:
             raise ValueError("mesh_model must be >= 1")
         if self.steps_per_loop < 1:
             raise ValueError("steps_per_loop must be >= 1")
+        if self.on_bad_record not in ("raise", "skip"):
+            raise ValueError(
+                f"on_bad_record must be 'raise' or 'skip', "
+                f"got {self.on_bad_record!r}")
+        if self.max_bad_records < 0:
+            raise ValueError("max_bad_records must be >= 0")
+        if self.io_retries < 1:
+            raise ValueError("io_retries must be >= 1")
+        if self.io_retry_backoff_secs < 0 or self.io_retry_deadline_secs < 0:
+            raise ValueError("io retry backoff/deadline must be >= 0")
+        if self.max_save_failures < 0:
+            raise ValueError("max_save_failures must be >= 0")
 
     # ---- derived views ------------------------------------------------
     @property

@@ -8,15 +8,56 @@ dispatch to ``tf.io.gfile`` for URL-style paths (``gs://``, ``s3://``,
 ``hdfs://`` — whatever the installed TF build supports) and to plain POSIX
 I/O otherwise. TensorFlow is imported lazily and only for remote paths, so
 local training never pays the import.
+
+Fault tolerance: every metadata op (glob/exists/size/isdir) and every open
+runs under the module :class:`~deepfm_tpu.utils.retry.RetryPolicy`, and
+:class:`ResilientStream` heals transient *mid-read* failures by reopening
+and repositioning to the last good byte offset. A process-wide fault
+injector seam (:func:`set_fault_injector`) lets tests and
+``scripts/fault_drill.py`` script deterministic failures INSIDE the retry
+loop, so the healing path itself is what gets exercised.
 """
 
 from __future__ import annotations
 
 import glob as _glob
+import io
 import os
-from typing import BinaryIO, List
+from typing import BinaryIO, Callable, List, Optional
+
+from ..utils import retry as _retry
 
 _gfile_mod = None
+
+# Module retry policy for filesystem ops. Replaceable (set_retry_policy) so
+# tasks.py can apply Config knobs and tests can zero out sleeps.
+_retry_policy = _retry.RetryPolicy()
+
+# Process-wide fault injector (see utils/faults.py). None in production.
+_injector = None
+
+
+def set_retry_policy(policy: _retry.RetryPolicy) -> _retry.RetryPolicy:
+    """Install the retry policy for all fileio ops; returns the previous."""
+    global _retry_policy
+    prev, _retry_policy = _retry_policy, policy
+    return prev
+
+
+def get_retry_policy() -> _retry.RetryPolicy:
+    return _retry_policy
+
+
+def set_fault_injector(inj) -> None:
+    """Install (or with None, remove) the process-wide fault injector.
+
+    The injector duck-type is two methods: ``on_op(op_name, path)`` called
+    inside the retry loop before each metadata/open op (raise to inject),
+    and ``wrap_stream(path, stream)`` called on freshly opened read streams
+    (return a wrapper to inject read faults).
+    """
+    global _injector
+    _injector = inj
 
 
 def is_remote(path: str) -> bool:
@@ -37,35 +78,59 @@ def _gfile():
 
 
 def open_stream(path: str, mode: str = "rb") -> BinaryIO:
-    """Open a (possibly remote) path for sequential reading."""
-    if is_remote(path):
-        return _gfile().GFile(path, mode)
-    return open(path, mode)
+    """Open a (possibly remote) path, retrying transient open failures."""
+    def _open() -> BinaryIO:
+        if _injector is not None:
+            _injector.on_op("open", path)
+        if is_remote(path):
+            f: BinaryIO = _gfile().GFile(path, mode)
+        else:
+            f = open(path, mode)
+        if _injector is not None and "r" in mode and "+" not in mode:
+            f = _injector.wrap_stream(path, f)
+        return f
+    return _retry_policy.call(_open, op_name=f"open({path})")
 
 
 def glob(pattern: str) -> List[str]:
-    if is_remote(pattern):
-        return sorted(_gfile().glob(pattern))
-    return sorted(_glob.glob(pattern))
+    def _glob_op() -> List[str]:
+        if _injector is not None:
+            _injector.on_op("glob", pattern)
+        if is_remote(pattern):
+            return sorted(_gfile().glob(pattern))
+        return sorted(_glob.glob(pattern))
+    return _retry_policy.call(_glob_op, op_name=f"glob({pattern})")
 
 
 def isdir(path: str) -> bool:
-    if is_remote(path):
-        return _gfile().isdir(path)
-    return os.path.isdir(path)
+    def _isdir_op() -> bool:
+        if _injector is not None:
+            _injector.on_op("isdir", path)
+        if is_remote(path):
+            return _gfile().isdir(path)
+        return os.path.isdir(path)
+    return _retry_policy.call(_isdir_op, op_name=f"isdir({path})")
 
 
 def exists(path: str) -> bool:
-    if is_remote(path):
-        return _gfile().exists(path)
-    return os.path.exists(path)
+    def _exists_op() -> bool:
+        if _injector is not None:
+            _injector.on_op("exists", path)
+        if is_remote(path):
+            return _gfile().exists(path)
+        return os.path.exists(path)
+    return _retry_policy.call(_exists_op, op_name=f"exists({path})")
 
 
 def size(path: str) -> int:
     """Byte length of a (possibly remote) file."""
-    if is_remote(path):
-        return int(_gfile().stat(path).length)
-    return os.path.getsize(path)
+    def _size_op() -> int:
+        if _injector is not None:
+            _injector.on_op("size", path)
+        if is_remote(path):
+            return int(_gfile().stat(path).length)
+        return os.path.getsize(path)
+    return _retry_policy.call(_size_op, op_name=f"size({path})")
 
 
 def makedirs(path: str) -> None:
@@ -106,3 +171,130 @@ def normalize_dir(path: str) -> str:
     if is_remote(path):
         return path.rstrip("/")
     return os.path.abspath(path)
+
+
+class ResilientStream(io.RawIOBase):
+    """Sequential read stream that survives transient mid-file failures.
+
+    Tracks the absolute byte offset of delivered data; when a read raises a
+    transient error the broken stream is dropped and — under the retry
+    policy's backoff — a fresh one is opened and repositioned to the last
+    good offset (``seek`` when the underlying stream supports it, otherwise
+    read-and-discard, matching object-store streams that only resume by
+    re-reading). ``read(n)`` always returns exactly ``n`` bytes except at
+    EOF, so the strictly sequential framers (``pipeline._iter_framed_stream``
+    and ``tfrecord.iter_records_from_stream``) get mid-file fault survival
+    without any changes of their own.
+    """
+
+    _DISCARD_CHUNK = 1 << 20
+
+    def __init__(self, path: str = "", *,
+                 opener: Optional[Callable[[], BinaryIO]] = None,
+                 policy: Optional[_retry.RetryPolicy] = None,
+                 on_retry: Optional[Callable[[BaseException, int], None]] = None):
+        super().__init__()
+        if opener is None:
+            if not path:
+                raise ValueError("ResilientStream needs a path or an opener")
+            opener = lambda: open_stream(path, "rb")  # noqa: E731
+        self._opener = opener
+        self._path = path or "<stream>"
+        self._policy = policy or _retry_policy
+        self._on_retry = on_retry
+        self._stream: Optional[BinaryIO] = None
+        self._offset = 0  # absolute offset of the next byte owed the caller
+        self.reopen_count = 0
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def tell(self) -> int:
+        return self._offset
+
+    def readable(self) -> bool:
+        return True
+
+    def seekable(self) -> bool:
+        return False
+
+    def _drop(self) -> None:
+        s, self._stream = self._stream, None
+        if s is not None:
+            try:
+                s.close()
+            except Exception:
+                pass  # a broken remote stream may refuse even close()
+
+    def _reposition(self, stream: BinaryIO) -> None:
+        try:
+            can_seek = bool(stream.seekable())
+        except Exception:
+            can_seek = hasattr(stream, "seek")
+        if can_seek and hasattr(stream, "seek"):
+            stream.seek(self._offset)
+            return
+        remaining = self._offset
+        while remaining > 0:
+            chunk = stream.read(min(remaining, self._DISCARD_CHUNK))
+            if not chunk:
+                raise IOError(
+                    f"reopen of {self._path} hit EOF at byte "
+                    f"{self._offset - remaining} before reaching the last "
+                    f"good offset {self._offset}")
+            remaining -= len(chunk)
+
+    def _read_some(self, want: int) -> bytes:
+        def attempt() -> bytes:
+            if self._stream is None:
+                stream = self._opener()
+                if self._offset:
+                    self._reposition(stream)
+                self._stream = stream
+            return self._stream.read(want)
+
+        def on_retry(exc: BaseException, n: int) -> None:
+            self._drop()
+            self.reopen_count += 1
+            if self._on_retry is not None:
+                self._on_retry(exc, n)
+
+        try:
+            return self._policy.call(
+                attempt, op_name=f"read({self._path}@{self._offset})",
+                on_retry=on_retry)
+        except BaseException:
+            self._drop()
+            raise
+
+    def read(self, n: int = -1) -> bytes:
+        if n is None or n < 0:
+            chunks = []
+            while True:
+                c = self.read(self._DISCARD_CHUNK)
+                if not c:
+                    return b"".join(chunks)
+                chunks.append(c)
+        if n == 0:
+            return b""
+        out = bytearray()
+        while len(out) < n:
+            chunk = self._read_some(n - len(out))
+            if not chunk:
+                break  # EOF
+            self._offset += len(chunk)
+            out += chunk
+        return bytes(out)
+
+    def close(self) -> None:
+        self._drop()
+        super().close()
+
+
+def open_resilient(path: str, *,
+                   policy: Optional[_retry.RetryPolicy] = None,
+                   on_retry: Optional[Callable[[BaseException, int], None]] = None,
+                   ) -> ResilientStream:
+    """Open ``path`` for reading behind transparent reopen-and-seek retry."""
+    return ResilientStream(path, policy=policy, on_retry=on_retry)

@@ -1,0 +1,140 @@
+"""Data-plane health accounting: bad-record policy + per-file fault stats.
+
+The reference delegated corrupt-shard handling to TF (silently fatal) and
+transient-read handling to SageMaker job restarts. Here both are explicit:
+:class:`BadRecordPolicy` decides raise-vs-skip for corrupt/truncated frames
+(with a skip budget), and :class:`DataHealth` aggregates per-file skip and
+retry counters so the training loop can log them every ``log_steps`` and at
+epoch end. Thread-safe — the pooled decode path and the prefetch thread both
+report into the same object.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+
+class DataHealth:
+    """Thread-safe counters for I/O faults survived by the pipeline."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.read_retries = 0        # transient read errors healed by retry
+        self.bad_records = 0         # corrupt records skipped
+        self.truncated_tails = 0     # files whose tail was discarded
+        self.bytes_discarded = 0     # payload bytes dropped with bad frames
+        self.per_file: Dict[str, Dict[str, int]] = {}
+        self._dirty = False
+
+    def _file(self, path: str) -> Dict[str, int]:
+        entry = self.per_file.get(path)
+        if entry is None:
+            entry = {"retries": 0, "skipped": 0}
+            self.per_file[path] = entry
+        return entry
+
+    def record_retry(self, path: str) -> None:
+        with self._lock:
+            self.read_retries += 1
+            self._file(path)["retries"] += 1
+            self._dirty = True
+
+    def record_bad_record(self, path: str, nbytes: int = 0, *,
+                          truncated: bool = False) -> None:
+        with self._lock:
+            self.bad_records += 1
+            self.bytes_discarded += int(nbytes)
+            if truncated:
+                self.truncated_tails += 1
+            self._file(path)["skipped"] += 1
+            self._dirty = True
+
+    @property
+    def total_events(self) -> int:
+        with self._lock:
+            return self.read_retries + self.bad_records
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "read_retries": self.read_retries,
+                "bad_records": self.bad_records,
+                "truncated_tails": self.truncated_tails,
+                "bytes_discarded": self.bytes_discarded,
+                "per_file": {k: dict(v) for k, v in self.per_file.items()},
+            }
+
+    def merge_into(self, totals: Dict[str, int]) -> None:
+        """Accumulate scalar counters into ``totals`` (for cross-epoch sums)."""
+        snap = self.snapshot()
+        for key in ("read_retries", "bad_records", "truncated_tails",
+                    "bytes_discarded"):
+            totals[key] = totals.get(key, 0) + int(snap[key])  # type: ignore[arg-type]
+
+    def summary(self) -> str:
+        snap = self.snapshot()
+        worst = sorted(
+            snap["per_file"].items(),  # type: ignore[union-attr]
+            key=lambda kv: -(kv[1]["retries"] + kv[1]["skipped"]))[:3]
+        files = ", ".join(
+            f"{p}(retries={c['retries']},skipped={c['skipped']})"
+            for p, c in worst)
+        return (f"read_retries={snap['read_retries']} "
+                f"bad_records={snap['bad_records']} "
+                f"truncated_tails={snap['truncated_tails']} "
+                f"bytes_discarded={snap['bytes_discarded']}"
+                + (f" [{files}]" if files else ""))
+
+    def consume_dirty(self) -> bool:
+        """True once per batch of new events — drives log_steps-cadence logs."""
+        with self._lock:
+            dirty, self._dirty = self._dirty, False
+            return dirty
+
+
+class BadRecordPolicy:
+    """raise|skip decision for corrupt or truncated TFRecord frames.
+
+    ``skip`` mode drops the offending record (or file tail, when framing can
+    no longer resync) and counts it in :class:`DataHealth`; ``max_bad`` > 0
+    bounds the total skips (budget exceeded → raise so a systemically
+    corrupt dataset cannot silently train on a fraction of the data).
+    ``max_bad == 0`` means unlimited.
+    """
+
+    def __init__(self, on_bad: str = "raise", max_bad: int = 0,
+                 health: Optional[DataHealth] = None):
+        if on_bad not in ("raise", "skip"):
+            raise ValueError(
+                f"on_bad_record must be 'raise' or 'skip', got {on_bad!r}")
+        self.on_bad = on_bad
+        self.max_bad = int(max_bad)
+        self.health = health if health is not None else DataHealth()
+        self._lock = threading.Lock()
+        self._skipped = 0
+
+    @property
+    def skips(self) -> int:
+        return self._skipped
+
+    def bad_record(self, path: str, offset: int, reason: str, *,
+                   nbytes: int = 0, truncated: bool = False) -> None:
+        """Handle one bad frame at absolute byte ``offset`` of ``path``.
+
+        Returns normally iff policy is skip and the budget allows; the
+        caller then drops the frame and continues.
+        """
+        label = path or "<stream>"
+        if self.on_bad != "skip":
+            raise IOError(
+                f"corrupt TFRecord: {reason} in {label} at byte {offset}")
+        with self._lock:
+            self._skipped += 1
+            over_budget = self.max_bad > 0 and self._skipped > self.max_bad
+        if over_budget:
+            raise IOError(
+                f"bad-record budget exceeded ({self._skipped} > "
+                f"max_bad_records={self.max_bad}); last: {reason} in "
+                f"{label} at byte {offset}")
+        self.health.record_bad_record(label, nbytes, truncated=truncated)

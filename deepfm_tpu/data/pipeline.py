@@ -27,6 +27,7 @@ from typing import BinaryIO, Dict, Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 
 from . import example_codec, fileio, sharding, tfrecord
+from .health import BadRecordPolicy, DataHealth
 
 Batch = Dict[str, np.ndarray]
 
@@ -77,31 +78,56 @@ def _native_loader():
     return None
 
 
-def _iter_framed_stream(stream: BinaryIO, loader, verify_crc: bool = True
+def _iter_framed_stream(stream: BinaryIO, loader, verify_crc: bool = True,
+                        *, path: str = "", policy: Optional[BadRecordPolicy] = None
                         ) -> Iterator[Tuple[bytes, np.ndarray, np.ndarray]]:
     """Chunked read() + C-speed framing with a carried partial tail: yields
     (buf, offsets, lengths) per chunk from any sequential byte source.
     Constant memory on multi-GB inputs, and plain I/O errors stay catchable
     Python exceptions (an mmap would turn them into SIGBUS). The single
     framing state machine shared by the record iterator, the vectorized
-    file path, and the streaming (Pipe-mode) path."""
+    file path, and the streaming (Pipe-mode) path.
+
+    Bad frames: the native framer rejects a corrupt chunk wholesale; the
+    chunk is then re-scanned by the pure-Python framer, which locates the
+    exact absolute byte offset (for the path+offset error message) and
+    applies the same raise/skip ``policy`` as the pure-Python decode path —
+    so both decoder paths surface identical locations and skip-policy
+    behavior. Clean data never takes the re-scan, keeping the fast path
+    byte-identical (TestPooledEmissionGolden)."""
     carry = b""
+    carry_base = 0  # absolute stream offset of carry[0]
     read_size = _NATIVE_CHUNK_BYTES
     while True:
         chunk = stream.read(read_size)
         if not chunk:
             if carry:
                 # Strict parse of the leftover: surfaces truncated-input
-                # as an error, not silence.
-                offsets, lengths = loader.split_frames(
-                    carry, verify_crc=verify_crc)
+                # as an error (or a counted skip under the policy).
+                try:
+                    offsets, lengths = loader.split_frames(
+                        carry, verify_crc=verify_crc)
+                except IOError:
+                    offsets, lengths, _, _ = tfrecord.scan_frames_partial(
+                        carry, verify_crc=verify_crc, final=True,
+                        base_offset=carry_base, path=path, policy=policy)
                 yield carry, offsets, lengths
             return
         buf = carry + chunk if carry else chunk
-        offsets, lengths, consumed = loader.split_frames_partial(
-            buf, verify_crc=verify_crc)
+        buf_base = carry_base
+        abort = False
+        try:
+            offsets, lengths, consumed = loader.split_frames_partial(
+                buf, verify_crc=verify_crc)
+        except IOError:
+            offsets, lengths, consumed, abort = tfrecord.scan_frames_partial(
+                buf, verify_crc=verify_crc, final=False,
+                base_offset=buf_base, path=path, policy=policy)
         yield buf, offsets, lengths
+        if abort:  # framing cannot resync past the corruption
+            return
         carry = buf[consumed:]
+        carry_base = buf_base + consumed
         # A record larger than the read size frames nothing (consumed=0);
         # double the next read so it completes in O(n) total copying
         # rather than O(n^2) re-copies of the growing carry.
@@ -109,25 +135,42 @@ def _iter_framed_stream(stream: BinaryIO, loader, verify_crc: bool = True
                      else max(read_size * 2, _NATIVE_CHUNK_BYTES))
 
 
-def _iter_framed_chunks(path: str, loader, verify_crc: bool = True
+def _health_retry_cb(policy: Optional[BadRecordPolicy], path: str):
+    """on_retry hook recording healed transient reads into DataHealth."""
+    if policy is None:
+        return None
+    health = policy.health
+    return lambda exc, n: health.record_retry(path)
+
+
+def _iter_framed_chunks(path: str, loader, verify_crc: bool = True, *,
+                        policy: Optional[BadRecordPolicy] = None,
+                        retry_policy=None
                         ) -> Iterator[Tuple[bytes, np.ndarray, np.ndarray]]:
-    """File-path front-end of ``_iter_framed_stream`` (local or gs://)."""
-    with fileio.open_stream(path, "rb") as f:
-        yield from _iter_framed_stream(f, loader, verify_crc)
+    """File-path front-end of ``_iter_framed_stream`` (local or gs://),
+    reading through a ResilientStream so transient mid-file errors heal."""
+    with fileio.open_resilient(path, policy=retry_policy,
+                               on_retry=_health_retry_cb(policy, path)) as f:
+        yield from _iter_framed_stream(f, loader, verify_crc,
+                                       path=path, policy=policy)
 
 
-def _iter_file_records(path: str, use_native: bool, verify_crc: bool = True
-                       ) -> Iterator[bytes]:
+def _iter_file_records(path: str, use_native: bool, verify_crc: bool = True,
+                       *, policy: Optional[BadRecordPolicy] = None,
+                       retry_policy=None) -> Iterator[bytes]:
     """Per-file record iterator with the same CRC policy on both paths
     (same integrity guarantee regardless of toolchain)."""
     loader = _native_loader() if use_native else None
     if loader is not None:
         for buf, offsets, lengths in _iter_framed_chunks(
-                path, loader, verify_crc):
+                path, loader, verify_crc, policy=policy,
+                retry_policy=retry_policy):
             for off, ln in zip(offsets.tolist(), lengths.tolist()):
                 yield buf[off:off + ln]
         return
-    yield from tfrecord.iter_records(path, verify_crc=verify_crc)
+    yield from tfrecord.iter_records(
+        path, verify_crc=verify_crc, policy=policy, resilient=True,
+        retry_policy=retry_policy, on_retry=_health_retry_cb(policy, path))
 
 
 def _available_cores() -> int:
@@ -197,6 +240,9 @@ class CtrPipeline:
         verify_crc: bool = False,  # speed-over-parity default (see Config); codec fns keep True
         epoch_offset: int = 0,
         skip_batches: int = 0,
+        on_bad_record: str = "raise",
+        max_bad_records: int = 0,
+        retry_policy=None,
     ):
         if shard is not None:
             self._files: Tuple[str, ...] = shard.files
@@ -237,6 +283,13 @@ class CtrPipeline:
         self.skip_batches = skip_batches
         self._decode = _get_decoder(use_native_decoder)
         self._scatter_pool = None  # lazy drain-decode executor (see close())
+        # Fault tolerance: one DataHealth/BadRecordPolicy pair per pipeline
+        # (skip budget spans every epoch of this pipeline's life); the
+        # retry policy governs opens + mid-file reopen-and-seek healing.
+        self.health = DataHealth()
+        self._bad_policy = BadRecordPolicy(
+            on_bad_record, max_bad_records, self.health)
+        self._retry_policy = retry_policy
 
     # ------------------------------------------------------------------
     # Vectorized fast path (native decode straight to arrays).
@@ -297,7 +350,9 @@ class CtrPipeline:
         got_any = False
         for path in files:
             for buf, offsets, lengths in _iter_framed_chunks(
-                    path, loader, self.verify_crc):
+                    path, loader, self.verify_crc,
+                    policy=self._bad_policy,
+                    retry_policy=self._retry_policy):
                 if len(offsets) == 0:
                     continue
                 got_any = True
@@ -533,7 +588,9 @@ class CtrPipeline:
         n_seen = 0
         for path in files:
             for rec in _iter_file_records(path, self._use_native,
-                                          self.verify_crc):
+                                          self.verify_crc,
+                                          policy=self._bad_policy,
+                                          retry_policy=self._retry_policy):
                 keep = (
                     self._record_shard is None
                     or n_seen % self._record_shard[0] == self._record_shard[1]
@@ -623,7 +680,8 @@ class ChainedFileStream:
 
     def __init__(self, files: Sequence[str], *, num_epochs: int = 1,
                  shuffle_each_epoch: bool = False, seed: int = 42,
-                 epoch_offset: int = 0):
+                 epoch_offset: int = 0, retry_policy=None,
+                 health: Optional[DataHealth] = None):
         if not files:
             raise ValueError("ChainedFileStream needs at least one file")
         self._files: List[str] = []
@@ -638,6 +696,18 @@ class ChainedFileStream:
             self._files.extend(fs)
         self._idx = 0
         self._fh: Optional[BinaryIO] = None
+        self._retry_policy = retry_policy
+        self._health = health
+
+    def _open_next(self, path: str) -> BinaryIO:
+        # Per-file resilient opens: a transient mid-file fault heals inside
+        # the producer, so the consumer's single-pass stream never breaks.
+        on_retry = None
+        if self._health is not None:
+            health = self._health
+            on_retry = lambda exc, n, p=path: health.record_retry(p)  # noqa: E731
+        return fileio.open_resilient(path, policy=self._retry_policy,
+                                     on_retry=on_retry)
 
     def read(self, n: int = -1) -> bytes:
         if n is None or n < 0:
@@ -647,7 +717,7 @@ class ChainedFileStream:
             if self._fh is None:
                 if self._idx >= len(self._files):
                     break
-                self._fh = fileio.open_stream(self._files[self._idx], "rb")
+                self._fh = self._open_next(self._files[self._idx])
                 self._idx += 1
             chunk = self._fh.read(n - len(out))
             if not chunk:
@@ -684,6 +754,10 @@ class StreamingCtrPipeline:
         record_shard: Optional[Tuple[int, int]] = None,
         verify_crc: bool = False,  # speed-over-parity default (see Config); codec fns keep True
         skip_batches: int = 0,
+        on_bad_record: str = "raise",
+        max_bad_records: int = 0,
+        stream_label: str = "<stream>",
+        health: Optional[DataHealth] = None,
     ):
         self.stream = stream
         self.field_size = field_size
@@ -696,13 +770,21 @@ class StreamingCtrPipeline:
         self.verify_crc = verify_crc
         self.skip_batches = skip_batches  # resume: drop the trained prefix
         self._consumed = False
+        # Shared-health option: ChainedFileStream heals retries on the
+        # producer side; passing its DataHealth here gives one unified
+        # stats object across the stream's producer and consumer.
+        self.health = health if health is not None else DataHealth()
+        self._stream_label = stream_label
+        self._bad_policy = BadRecordPolicy(
+            on_bad_record, max_bad_records, self.health)
 
     def _iter_records(self) -> Iterator[bytes]:
         """Stream records, applying the (world, rank) record shard when this
         process shares the stream with others (the dataset.shard analog for
         Pipe mode — without it every rank would train the identical bytes)."""
         it = tfrecord.iter_records_from_stream(
-            self.stream, verify_crc=self.verify_crc)
+            self.stream, verify_crc=self.verify_crc,
+            path=self._stream_label, policy=self._bad_policy)
         if self._record_shard is None:
             yield from it
             return
@@ -730,7 +812,8 @@ class StreamingCtrPipeline:
         n_pend = 0
         n_seen = 0
         for buf, offsets, lengths in _iter_framed_stream(
-                self.stream, loader, self.verify_crc):
+                self.stream, loader, self.verify_crc,
+                path=self._stream_label, policy=self._bad_policy):
             if len(offsets) == 0:
                 continue
             labels, ids, vals = loader.decode_spans(
@@ -854,7 +937,8 @@ def _prefetch(it: Iterator[Batch], depth: int) -> Iterator[Batch]:
                 if close is not None:
                     close()
 
-    t = threading.Thread(target=worker, daemon=True)
+    t = threading.Thread(target=worker, daemon=True,
+                         name="pipeline-prefetch")
     t.start()
     try:
         while True:
@@ -862,7 +946,21 @@ def _prefetch(it: Iterator[Batch], depth: int) -> Iterator[Batch]:
             if item is _END:
                 return
             if isinstance(item, BaseException):
-                raise item
+                # `from None` severs the misleading implicit context (the
+                # queue.Full/Empty juggling above); the note names the
+                # producer thread so consumer-side tracebacks distinguish
+                # pipeline faults from trainer faults.
+                note = (f"raised in pipeline prefetch thread {t.name!r} "
+                        "(data pipeline fault, not a trainer fault)")
+                if hasattr(item, "add_note"):  # py3.11+
+                    item.add_note(note)
+                else:
+                    notes = getattr(item, "__notes__", None)
+                    if isinstance(notes, list):
+                        notes.append(note)
+                    else:
+                        item.__notes__ = [note]
+                raise item from None
             yield item
     finally:
         stop.set()

@@ -118,68 +118,178 @@ class TFRecordWriter:
         self.close()
 
 
-def iter_records_from_stream(stream: BinaryIO, *, verify_crc: bool = True) -> Iterator[bytes]:
+def _frame_fault(policy, path: str, offset: int, reason: str, *,
+                 nbytes: int = 0, truncated: bool = False) -> None:
+    """Route one bad frame through the policy, or raise with path+offset."""
+    if policy is not None:
+        policy.bad_record(path, offset, reason, nbytes=nbytes,
+                         truncated=truncated)
+        return
+    label = path or "<stream>"
+    raise IOError(f"corrupt TFRecord: {reason} in {label} at byte {offset}")
+
+
+def iter_records_from_stream(stream: BinaryIO, *, verify_crc: bool = True,
+                             path: str = "", policy=None) -> Iterator[bytes]:
     """Sequential record iterator over any non-seekable byte stream.
 
     This is the streaming/Pipe-mode primitive: it never seeks, so it works on
     FIFOs and sockets exactly like the reference's PipeModeDataset C++ reader
-    (X3). Raises on corrupt framing; truncated tail is treated as EOF only if
-    the stream ends exactly at a record boundary header.
+    (X3). Truncated tail is treated as EOF only if the stream ends exactly at
+    a record boundary header. ``path`` labels error messages with the source
+    plus the absolute byte offset of the bad frame; ``policy`` (a
+    ``health.BadRecordPolicy``) turns raises into counted skips — a data-CRC
+    mismatch skips just that record, while a length-CRC mismatch or a
+    truncated frame discards the rest of the stream (framing cannot resync).
     """
+    pos = 0
     while True:
         header = stream.read(12)
         if not header:
             return
         if len(header) < 12:
-            raise IOError("truncated TFRecord header")
+            _frame_fault(policy, path, pos, "truncated TFRecord header",
+                         nbytes=len(header), truncated=True)
+            return
         (length,) = struct.unpack("<Q", header[:8])
         (len_crc,) = struct.unpack("<I", header[8:12])
         if verify_crc and masked_crc32c(header[:8]) != len_crc:
-            raise IOError("corrupt TFRecord: length CRC mismatch")
+            _frame_fault(policy, path, pos,
+                         "length CRC mismatch (cannot resync; "
+                         "discarding rest of file)", truncated=True)
+            return
         payload = stream.read(length + 4)
         if len(payload) < length + 4:
-            raise IOError("truncated TFRecord payload")
+            _frame_fault(policy, path, pos, "truncated TFRecord payload",
+                         nbytes=12 + len(payload), truncated=True)
+            return
         data, (data_crc,) = payload[:length], struct.unpack("<I", payload[length:])
         if verify_crc and masked_crc32c(data) != data_crc:
-            raise IOError("corrupt TFRecord: data CRC mismatch")
+            _frame_fault(policy, path, pos, "data CRC mismatch",
+                         nbytes=12 + length + 4)
+            pos += 12 + length + 4
+            continue
+        pos += 12 + length + 4
         yield data
 
 
-def iter_records(path: str, *, verify_crc: bool = True) -> Iterator[bytes]:
-    """Iterate records of a TFRecord file (local or gs://)."""
+def iter_records(path: str, *, verify_crc: bool = True,
+                 policy=None, resilient: bool = False,
+                 retry_policy=None, on_retry=None) -> Iterator[bytes]:
+    """Iterate records of a TFRecord file (local or gs://).
+
+    ``resilient=True`` reads through :class:`fileio.ResilientStream` so
+    transient mid-file errors heal by reopen-and-seek.
+    """
     from . import fileio  # noqa: PLC0415 (avoid import cycle at module load)
+    if resilient:
+        with fileio.open_resilient(path, policy=retry_policy,
+                                   on_retry=on_retry) as f:
+            yield from iter_records_from_stream(
+                f, verify_crc=verify_crc, path=path, policy=policy)
+        return
     if fileio.is_remote(path):
         with fileio.open_stream(path, "rb") as f:
-            yield from iter_records_from_stream(f, verify_crc=verify_crc)
+            yield from iter_records_from_stream(
+                f, verify_crc=verify_crc, path=path, policy=policy)
         return
     with open(path, "rb", buffering=1 << 20) as f:
-        yield from iter_records_from_stream(f, verify_crc=verify_crc)
+        yield from iter_records_from_stream(
+            f, verify_crc=verify_crc, path=path, policy=policy)
 
 
 def read_all_records(path: str, *, verify_crc: bool = True) -> List[bytes]:
     return list(iter_records(path, verify_crc=verify_crc))
 
 
-def split_record_frames(buf: bytes, *, verify_crc: bool = False) -> List[bytes]:
+def split_record_frames(buf: bytes, *, verify_crc: bool = False,
+                        path: str = "") -> List[bytes]:
     """Split a whole-file byte buffer into record payloads (no copies of buf)."""
+    label = path or "<buffer>"
     out: List[bytes] = []
     pos, end = 0, len(buf)
     while pos < end:
         if end - pos < 12:
-            raise IOError("truncated TFRecord header")
+            raise IOError(f"truncated TFRecord header in {label} "
+                          f"at byte {pos}")
         (length,) = struct.unpack_from("<Q", buf, pos)
         if verify_crc:
             (len_crc,) = struct.unpack_from("<I", buf, pos + 8)
             if masked_crc32c(buf[pos:pos + 8]) != len_crc:
-                raise IOError("corrupt TFRecord: length CRC mismatch")
+                raise IOError(f"corrupt TFRecord: length CRC mismatch in "
+                              f"{label} at byte {pos}")
         pos += 12
         if end - pos < length + 4:
-            raise IOError("truncated TFRecord payload")
+            raise IOError(f"truncated TFRecord payload in {label} "
+                          f"at byte {pos - 12}")
         data = buf[pos:pos + length]
         if verify_crc:
             (data_crc,) = struct.unpack_from("<I", buf, pos + length)
             if masked_crc32c(data) != data_crc:
-                raise IOError("corrupt TFRecord: data CRC mismatch")
+                raise IOError(f"corrupt TFRecord: data CRC mismatch in "
+                              f"{label} at byte {pos - 12}")
         out.append(data)
         pos += length + 4
     return out
+
+
+def scan_frames_partial(buf, *, verify_crc: bool = True, final: bool = False,
+                        base_offset: int = 0, path: str = "", policy=None):
+    """Pure-Python analog of ``native.loader.split_frames_partial`` with
+    bad-record policy support.
+
+    Frames as many complete records out of ``buf`` as possible and returns
+    ``(offsets, lengths, consumed, abort)`` where ``offsets``/``lengths``
+    are int64 arrays of payload spans within ``buf``, ``consumed`` is how
+    many bytes of ``buf`` were fully processed (skipped bad records count as
+    consumed), and ``abort`` means framing cannot continue past ``consumed``
+    (length-CRC corruption or, when ``final``, a truncated tail) — the
+    caller must stop reading this stream. ``base_offset`` is the absolute
+    stream offset of ``buf[0]`` so error messages and health entries carry
+    true file offsets. The pipeline only calls this when the native framer
+    rejects a chunk, so the Python re-scan both locates the exact bad byte
+    and applies the same skip/raise policy as the pure-Python decode path.
+    """
+    offsets: List[int] = []
+    lengths: List[int] = []
+    pos, end = 0, len(buf)
+    abort = False
+    while True:
+        avail = end - pos
+        if avail < 12:
+            if final and avail > 0:
+                _frame_fault(policy, path, base_offset + pos,
+                             "truncated TFRecord header", nbytes=avail,
+                             truncated=True)
+                pos, abort = end, True
+            break
+        (length,) = struct.unpack_from("<Q", buf, pos)
+        if verify_crc:
+            (len_crc,) = struct.unpack_from("<I", buf, pos + 8)
+            if masked_crc32c(bytes(buf[pos:pos + 8])) != len_crc:
+                _frame_fault(policy, path, base_offset + pos,
+                             "length CRC mismatch (cannot resync; "
+                             "discarding rest of file)", truncated=True)
+                pos, abort = end, True
+                break
+        total = 12 + length + 4
+        if avail < total:
+            if final:
+                _frame_fault(policy, path, base_offset + pos,
+                             "truncated TFRecord payload", nbytes=avail,
+                             truncated=True)
+                pos, abort = end, True
+            break
+        if verify_crc:
+            data = bytes(buf[pos + 12:pos + 12 + length])
+            (data_crc,) = struct.unpack_from("<I", buf, pos + 12 + length)
+            if masked_crc32c(data) != data_crc:
+                _frame_fault(policy, path, base_offset + pos,
+                             "data CRC mismatch", nbytes=total)
+                pos += total
+                continue
+        offsets.append(pos + 12)
+        lengths.append(length)
+        pos += total
+    return (np.asarray(offsets, dtype=np.int64),
+            np.asarray(lengths, dtype=np.int64), pos, abort)

@@ -27,7 +27,18 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.5: experimental location, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        # check_rep's static replication inference predates check_vma's and
+        # rejects valid pmean-replicated outputs; disable rather than fail.
+        del check_vma
+        return _shard_map_legacy(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=False)
 
 from ..config import Config
 from ..models import get_model
@@ -659,6 +670,11 @@ class Trainer:
                 eps = examples_since_log / max(dt, 1e-9)
                 ulog.info(
                     f"step={gstep} loss={loss:.5f} examples/sec={eps:,.0f}")
+                health = getattr(batches, "health", None)
+                if health is not None and health.consume_dirty():
+                    # Fault events (healed retries / skipped records) since
+                    # the last log line — same cadence as the loss log.
+                    ulog.info(f"data health: {health.summary()}")
                 if on_log is not None:
                     # Same cadence as the log line: loss/step were already
                     # synced above, so the callback adds no device reads.

@@ -35,6 +35,7 @@ from ..utils import checkpoint as ckpt_lib
 from ..utils import export as export_lib
 from ..utils import logging as ulog
 from ..utils import profiling as prof_lib
+from ..utils import retry as retry_lib
 from .loop import Trainer, pad_batch
 from .state import TrainState
 
@@ -147,6 +148,15 @@ def _validate_shard_coverage(cfg: Config, files: List[str]) -> None:
     shard_lib.validate_shard_coverage(specs, sorted(files))
 
 
+def _fault_tolerance_kwargs(cfg: Config) -> Dict:
+    """Bad-record policy + I/O retry knobs shared by every pipeline build."""
+    return dict(
+        on_bad_record=cfg.on_bad_record,
+        max_bad_records=cfg.max_bad_records,
+        retry_policy=retry_lib.policy_from_config(cfg),
+    )
+
+
 def make_pipeline(cfg: Config, files: List[str], *, epochs: int = 1,
                   shuffle: bool = True, sharded: bool = True,
                   drop_remainder: Optional[bool] = None,
@@ -169,6 +179,7 @@ def make_pipeline(cfg: Config, files: List[str], *, epochs: int = 1,
         use_native_decoder=cfg.use_native_decoder,
         reader_threads=cfg.reader_threads,
         verify_crc=cfg.verify_crc,
+        **_fault_tolerance_kwargs(cfg),
     )
 
 
@@ -188,10 +199,15 @@ def make_streaming_pipeline(cfg: Config, files: List[str], *, epochs: int = 1,
     record-level component carries through — when ranks share the same files
     (fewer files than processes), each keeps every world-th record."""
     shard = _shard_spec(cfg, files)
+    # One DataHealth shared by producer and consumer: the chained stream
+    # heals transient read faults per file (so retries carry file names),
+    # the consumer counts bad records against the same stats object.
+    health = pipe_lib.DataHealth()
     stream = pipe_lib.ChainedFileStream(
         list(shard.files), num_epochs=epochs,
         shuffle_each_epoch=cfg.shuffle_files, seed=cfg.seed,
-        epoch_offset=epoch_offset)
+        epoch_offset=epoch_offset,
+        retry_policy=retry_lib.policy_from_config(cfg), health=health)
     return pipe_lib.StreamingCtrPipeline(
         stream,
         field_size=cfg.field_size,
@@ -202,6 +218,9 @@ def make_streaming_pipeline(cfg: Config, files: List[str], *, epochs: int = 1,
         record_shard=shard.record_shard,
         skip_batches=skip_batches,
         verify_crc=cfg.verify_crc,
+        on_bad_record=cfg.on_bad_record,
+        max_bad_records=cfg.max_bad_records,
+        health=health,
     )
 
 
@@ -248,6 +267,9 @@ def _restore_or_init(trainer: Trainer, cfg: Config, require: bool,
 def run(cfg: Config) -> Dict[str, float]:
     """Entry point: bootstrap, dispatch on task_type, return result metrics."""
     bootstrap.initialize(cfg)
+    # Config-driven retry for every fileio op (glob/stat/open + the resume
+    # sidecar reads) — not just the pipelines' own streams.
+    fileio.set_retry_policy(retry_lib.policy_from_config(cfg))
     ulog.info(
         f"task={cfg.task_type} model={cfg.model} processes="
         f"{jax.process_count()} devices={len(jax.devices())}")
@@ -280,7 +302,7 @@ def _eval_check_due(n_dispatch: int) -> bool:
 
 def _make_throttled_eval_hook(trainer: Trainer, cfg: Config,
                               va_files: List[str], result: Dict[str, float],
-                              on_eval=None):
+                              on_eval=None, evaluate=None):
     """Mid-train eval hook with TrainSpec/EvalSpec timing semantics
     (start_delay_secs / throttle_secs, reference 1-ps-cpu/...py:440-441).
 
@@ -311,8 +333,8 @@ def _make_throttled_eval_hook(trainer: Trainer, cfg: Config,
         if not due:
             return
         last_eval_t[0] = _time.time()
-        ev = trainer.evaluate(
-            state, _eval_pipeline(cfg, va_files))
+        ev = (evaluate(state) if evaluate is not None
+              else trainer.evaluate(state, _eval_pipeline(cfg, va_files)))
         result["mid_train_evals"] += 1
         result.update({"auc": ev["auc"], "eval_loss": ev["loss"],
                        "eval_examples_per_sec": ev["examples_per_sec"]})
@@ -509,7 +531,8 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
     if cfg.model_dir:
         mgr = ckpt_lib.CheckpointManager(
             cfg.model_dir, max_to_keep=cfg.keep_checkpoint_max,
-            save_interval_steps=cfg.save_checkpoints_steps)
+            save_interval_steps=cfg.save_checkpoints_steps,
+            max_save_failures=cfg.max_save_failures)
     state = _restore_or_init(trainer, cfg, require=False, mgr=mgr)
     restored_step = int(state.step)
     # The resume decision is computed on the CHIEF ONLY and broadcast to all
@@ -544,6 +567,26 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
         cfg.eval_start_delay_secs > 0 or cfg.eval_throttle_secs > 0)
 
     result: Dict[str, float] = {}
+
+    # Cross-epoch fault accounting: each pipeline (train AND eval) owns a
+    # DataHealth; fold them into one total so the run reports exact
+    # retry/skip counts (asserted by scripts/fault_drill.py).
+    health_totals: Dict[str, int] = {}
+
+    def _log_health(pipeline, where: str) -> None:
+        health = getattr(pipeline, "health", None)
+        if health is None:
+            return
+        if health.total_events:
+            ulog.info(f"data health ({where}): {health.summary()}")
+        health.merge_into(health_totals)
+
+    def _run_eval(at_state: TrainState, where: str) -> Dict[str, float]:
+        pipe = _eval_pipeline(cfg, va_files)
+        ev = trainer.evaluate(at_state, pipe)
+        _log_health(pipe, where)
+        return ev
+
     # Data-pipeline position for the resume sidecar; epoch_start is the
     # global step at which the current epoch's batch 0 was (or would have
     # been) trained, so steps_into_epoch == batches consumed this epoch.
@@ -614,8 +657,9 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
             cfg.profile_dir, num_steps=cfg.profile_steps)
         hooks.append(lambda s, m: tracer.on_step(int(m.get("steps_done", 1))))
         if eval_throttled:
-            hooks.append(_make_throttled_eval_hook(trainer, cfg, va_files,
-                                                   result, on_eval=_tb_eval))
+            hooks.append(_make_throttled_eval_hook(
+                trainer, cfg, va_files, result, on_eval=_tb_eval,
+                evaluate=lambda s: _run_eval(s, "throttled eval")))
         try:
             if cfg.pipe_mode:
                 # Streaming (Pipe-mode analog): ONE train call consuming a
@@ -629,13 +673,13 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                     skip_batches=skip_batches, epoch_offset=epoch_base)
                 state, fit_m = trainer.fit(state, pipeline, hooks=hooks,
                                            on_log=_tb_log)
+                _log_health(pipeline, "stream end")
                 if fit_m["steps"]:
                     result["loss"] = fit_m["loss"]
                     result["examples_per_sec"] = fit_m.get(
                         "examples_per_sec", 0.0)
                 if va_files:
-                    ev = trainer.evaluate(
-                        state, _eval_pipeline(cfg, va_files))
+                    ev = _run_eval(state, "stream eval")
                     ulog.info(f"streaming train done: eval auc={ev['auc']:.5f} "
                               f"loss={ev['loss']:.5f}")
                     result.update({"auc": ev["auc"], "eval_loss": ev["loss"],
@@ -663,6 +707,7 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                                       else 0))
                     state, fit_m = trainer.fit(state, pipeline, hooks=hooks,
                                                on_log=_tb_log)
+                    _log_health(pipeline, f"epoch {epoch + 1} end")
                     if fit_m["steps"]:
                         # (a fully-skipped resumed epoch reports no loss)
                         result["loss"] = fit_m["loss"]
@@ -679,8 +724,7 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                         _write_resume_meta(
                             cfg.model_dir, _meta(step_counter[0], False))
                     if va_files and not eval_throttled:
-                        ev = trainer.evaluate(
-                            state, _eval_pipeline(cfg, va_files))
+                        ev = _run_eval(state, f"epoch {epoch + 1} eval")
                         ulog.info(
                             f"epoch {epoch + 1}/{cfg.num_epochs}: eval auc="
                             f"{ev['auc']:.5f} loss={ev['loss']:.5f}")
@@ -690,8 +734,7 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                         _tb_eval(ev)
                 if va_files and eval_throttled:
                     # Final eval at completion (train_and_evaluate does one).
-                    ev = trainer.evaluate(
-                        state, _eval_pipeline(cfg, va_files))
+                    ev = _run_eval(state, "final eval")
                     ulog.info(f"final eval: auc={ev['auc']:.5f} "
                               f"loss={ev['loss']:.5f}")
                     result.update({"auc": ev["auc"], "eval_loss": ev["loss"],
@@ -713,6 +756,8 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
         out = fileio.join(cfg.servable_model_dir, str(int(state.step)))
         export_lib.export_serving(trainer.model, state, cfg, out)
     result["steps"] = float(int(state.step))
+    result["read_retries"] = float(health_totals.get("read_retries", 0))
+    result["bad_records"] = float(health_totals.get("bad_records", 0))
     return result
 
 
@@ -760,7 +805,8 @@ def _task_infer(trainer: Trainer, cfg: Config) -> Dict[str, float]:
         shuffle=False, shuffle_files=False, drop_remainder=False,
         seed=cfg.seed, shard=shard, prefetch_batches=cfg.prefetch_batches,
         use_native_decoder=cfg.use_native_decoder,
-        reader_threads=cfg.reader_threads, verify_crc=cfg.verify_crc)
+        reader_threads=cfg.reader_threads, verify_crc=cfg.verify_crc,
+        **_fault_tolerance_kwargs(cfg))
 
     # Collectives inside predict_step require every process to run the same
     # number of rounds, but per-rank record counts can differ by one. Rather

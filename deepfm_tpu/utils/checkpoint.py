@@ -25,7 +25,8 @@ class CheckpointManager:
     """Thin wrapper over ocp.CheckpointManager for the TrainState pytree."""
 
     def __init__(self, directory: str, *, max_to_keep: int = 3,
-                 save_interval_steps: int = 0, async_save: bool = True):
+                 save_interval_steps: int = 0, async_save: bool = True,
+                 max_save_failures: int = 3):
         self._dir = fileio.normalize_dir(directory)
         fileio.makedirs(self._dir)
         options = ocp.CheckpointManagerOptions(
@@ -36,6 +37,13 @@ class CheckpointManager:
         self.save_interval_steps = save_interval_steps
         self._last_should_save_step: Optional[int] = None
         self._saved_steps: set = set()
+        self._max_to_keep = max_to_keep
+        # Save hardening: a transient interval-save failure logs and defers
+        # to the next interval; only this many CONSECUTIVE failures abort.
+        # (0 = abort on the first failure.) Forced saves always hard-fail.
+        self.max_save_failures = max_save_failures
+        self.save_failures = 0          # total failed save attempts
+        self._consecutive_failures = 0
 
     @property
     def directory(self) -> str:
@@ -44,15 +52,47 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def _do_save(self, step: int, state: Any, force: bool) -> bool:
+        """The actual Orbax write. Seam for fault injection (FlakyFS
+        patches this) — keep all failure handling in save() above it."""
+        return self._mgr.save(step, args=ocp.args.StandardSave(state),
+                              force=force)
+
     def save(self, step: int, state: Any, *, force: bool = False) -> bool:
         # Dedup against steps saved THIS session too: async saves may not yet
         # appear in all_steps() when the final forced save lands on the same
         # step as an in-flight interval save.
         if step in self._saved_steps or step in self._mgr.all_steps():
             return False  # e.g. final forced save after an interval save hit it
-        saved = self._mgr.save(step, args=ocp.args.StandardSave(state), force=force)
+        try:
+            saved = self._do_save(step, state, force)
+        except Exception as e:
+            self.save_failures += 1
+            self._consecutive_failures += 1
+            if force:
+                # The final save is the run's deliverable — losing it
+                # silently would discard the training; let it kill the job.
+                raise
+            if self._consecutive_failures > self.max_save_failures:
+                raise IOError(
+                    f"checkpoint save failed {self._consecutive_failures} "
+                    f"consecutive times (max_save_failures="
+                    f"{self.max_save_failures}) at step {step}: {e}") from e
+            ulog.warning(
+                f"checkpoint save at step {step} failed "
+                f"({self._consecutive_failures} consecutive, tolerating "
+                f"{self.max_save_failures}); deferring to next interval: {e}")
+            return False
+        self._consecutive_failures = 0
         if saved:
             self._saved_steps.add(step)
+            # Steps are monotonic and Orbax only retains max_to_keep
+            # checkpoints, so the session dedup set needs just the most
+            # recent entries — unpruned it leaks one int per save for the
+            # whole run (weeks-long jobs).
+            keep_n = max(self._max_to_keep, 8)
+            if len(self._saved_steps) > keep_n:
+                self._saved_steps = set(sorted(self._saved_steps)[-keep_n:])
             ulog.info(f"checkpoint saved at step {step} -> {self._dir}")
         return saved
 

@@ -1,0 +1,187 @@
+"""Deterministic fault injection for the I/O and checkpoint stack.
+
+The reference validated fault tolerance by killing SageMaker instances; our
+equivalent is scripted and reproducible: :class:`FlakyFS` installs itself as
+the ``fileio`` fault injector (see ``fileio.set_fault_injector``) and raises
+``IOError`` at exact call counts and byte offsets — every planned fault
+fires exactly once, so two runs with the same plan see the identical fault
+sequence. Faults are injected INSIDE the retry loop, which is the point:
+the healing machinery (``RetryPolicy`` backoff, ``ResilientStream``
+reopen-and-seek, checkpoint save deferral) is what gets exercised, not
+bypassed.
+
+Used by the ``faults``-marked tests and ``scripts/fault_drill.py``. No
+sleeps here — pair with a zero-delay ``RetryPolicy`` for millisecond tests.
+"""
+
+from __future__ import annotations
+
+import io
+import threading
+from typing import Dict, Iterable, Optional, Tuple
+
+from ..data import fileio
+
+
+class InjectedFault(IOError):
+    """Marker subclass so tests can tell injected faults from real ones.
+    An IOError, so the default retryable classification applies."""
+
+
+class FlakyStream(io.RawIOBase):
+    """Read-stream wrapper raising scripted faults; otherwise transparent."""
+
+    def __init__(self, fs: "FlakyFS", path: str, inner):
+        super().__init__()
+        self._fs = fs
+        self._path = path
+        self._inner = inner
+        self._pos = 0
+
+    def readable(self) -> bool:
+        return True
+
+    def seekable(self) -> bool:
+        if self._fs.hide_seek:
+            return False
+        try:
+            return bool(self._inner.seekable())
+        except Exception:
+            return hasattr(self._inner, "seek")
+
+    def seek(self, offset: int, whence: int = 0) -> int:
+        if self._fs.hide_seek:
+            raise io.UnsupportedOperation(
+                "seek disabled by FlakyFS(hide_seek=True)")
+        pos = self._inner.seek(offset, whence)
+        self._pos = pos if pos is not None else offset
+        return self._pos
+
+    def tell(self) -> int:
+        return self._pos
+
+    def read(self, n: int = -1) -> bytes:
+        self._fs.maybe_fail_read(self._path, self._pos, n)
+        chunk = self._inner.read(n)
+        if chunk:
+            self._pos += len(chunk)
+        return chunk
+
+    def close(self) -> None:
+        try:
+            self._inner.close()
+        finally:
+            super().close()
+
+
+class FlakyFS:
+    """Deterministic fault plan over the whole fileio layer.
+
+    Parameters script the faults:
+      * ``read_fail_every=k``: every k-th ``read()`` call (counted globally
+        across all streams, including retry re-reads) raises once. ``k=1``
+        makes every read fail — a permanent outage.
+      * ``read_fail_offsets``: iterable of ``(path_substring, byte_offset)``;
+        the first read on a matching stream that would cover that offset
+        raises, once per entry.
+      * ``op_failures``: e.g. ``{"glob": 2, "open": 1}`` — the first N calls
+        of that fileio op raise, one fault per call.
+      * ``save_failures=n``: the first n ``CheckpointManager._do_save``
+        calls raise (patched while the context is active; the checkpoint
+        module is imported lazily so data-only tests never pull in jax).
+
+    Use as a context manager; counters (``injected_read_faults`` etc.) let
+    tests assert DataHealth reported *exactly* the injected fault count.
+    """
+
+    def __init__(self, *, read_fail_every: int = 0,
+                 read_fail_offsets: Iterable[Tuple[str, int]] = (),
+                 op_failures: Optional[Dict[str, int]] = None,
+                 save_failures: int = 0,
+                 hide_seek: bool = False,
+                 error_factory=None):
+        self.read_fail_every = int(read_fail_every)
+        self._offset_plan = [(str(sub), int(off))
+                             for sub, off in read_fail_offsets]
+        self._offset_fired = [False] * len(self._offset_plan)
+        self._op_remaining = dict(op_failures or {})
+        self._save_remaining = int(save_failures)
+        self.hide_seek = hide_seek
+        self._error = error_factory or (lambda msg: InjectedFault(msg))
+        self._lock = threading.Lock()
+        self._read_calls = 0
+        self.injected_read_faults = 0
+        self.injected_op_faults = 0
+        self.injected_save_faults = 0
+        self._ckpt_patch = None  # (cls, original _do_save) while active
+
+    # -- fileio injector duck-type -------------------------------------
+    def on_op(self, op: str, path: str) -> None:
+        with self._lock:
+            left = self._op_remaining.get(op, 0)
+            if left <= 0:
+                return
+            self._op_remaining[op] = left - 1
+            self.injected_op_faults += 1
+        raise self._error(f"injected {op} fault on {path}")
+
+    def wrap_stream(self, path: str, stream) -> FlakyStream:
+        return FlakyStream(self, path, stream)
+
+    # -- read-fault plan -----------------------------------------------
+    def maybe_fail_read(self, path: str, pos: int, n: int) -> None:
+        with self._lock:
+            self._read_calls += 1
+            idx = self._read_calls
+            fail = (self.read_fail_every > 0
+                    and idx % self.read_fail_every == 0)
+            reason = f"read #{idx}"
+            if not fail:
+                end = pos + (n if n and n > 0 else 1)
+                for i, (sub, off) in enumerate(self._offset_plan):
+                    if (not self._offset_fired[i] and sub in path
+                            and pos <= off < end):
+                        self._offset_fired[i] = True
+                        fail = True
+                        reason = f"offset {off}"
+                        break
+            if fail:
+                self.injected_read_faults += 1
+        if fail:
+            raise self._error(
+                f"injected transient read fault ({reason}) on {path} "
+                f"at byte {pos}")
+
+    # -- checkpoint-save plan ------------------------------------------
+    def _patch_checkpoint_saves(self) -> None:
+        from . import checkpoint as ckpt_lib  # noqa: PLC0415 (lazy: jax/orbax)
+        fs = self
+        original = ckpt_lib.CheckpointManager._do_save
+
+        def flaky_do_save(mgr_self, step, state, force):
+            with fs._lock:
+                inject = fs._save_remaining > 0
+                if inject:
+                    fs._save_remaining -= 1
+                    fs.injected_save_faults += 1
+            if inject:
+                raise fs._error(
+                    f"injected checkpoint-save fault at step {step}")
+            return original(mgr_self, step, state, force)
+
+        ckpt_lib.CheckpointManager._do_save = flaky_do_save
+        self._ckpt_patch = (ckpt_lib.CheckpointManager, original)
+
+    # -- lifecycle ------------------------------------------------------
+    def __enter__(self) -> "FlakyFS":
+        fileio.set_fault_injector(self)
+        if self._save_remaining > 0:
+            self._patch_checkpoint_saves()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        fileio.set_fault_injector(None)
+        if self._ckpt_patch is not None:
+            cls, original = self._ckpt_patch
+            cls._do_save = original
+            self._ckpt_patch = None

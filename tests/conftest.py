@@ -26,3 +26,9 @@ import jax  # noqa: E402
 def pytest_configure(config):
     assert len(jax.devices()) == 8, (
         f"expected 8 virtual CPU devices, got {jax.devices()}")
+    config.addinivalue_line(
+        "markers",
+        "faults: fault-injection robustness tests (CPU-only, injected "
+        "clock/sleep — no real backoff sleeps)")
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from tier-1")

@@ -1,0 +1,278 @@
+"""Bad-record policy tests: corruption handling parity across decoder paths.
+
+The hard requirement (see utils/retry.py module docs): the native C++ framer
+and the pure-Python framer must make IDENTICAL policy decisions — same
+surviving records, same DataHealth counts, same error text — for every
+corruption class: flipped data CRC (skip one record, keep framing), flipped
+length CRC (cannot resync → discard file tail), and a truncated tail.
+Transient mid-file read errors must heal to clean-run-identical output.
+
+All tests are CPU-only and sleep-free (zero-backoff RetryPolicy).
+"""
+
+import os
+import struct
+
+import pytest
+
+from deepfm_tpu.data import libsvm, pipeline, tfrecord
+from deepfm_tpu.data.health import BadRecordPolicy, DataHealth
+from deepfm_tpu.utils import faults
+from deepfm_tpu.utils import retry as retry_lib
+
+pytestmark = pytest.mark.faults
+
+NATIVE = [
+    pytest.param(False, id="python"),
+    pytest.param(True, id="native", marks=pytest.mark.skipif(
+        not pipeline._native_loader(), reason="native loader unavailable")),
+]
+
+NO_SLEEP = retry_lib.RetryPolicy(base_delay=0.0, max_delay=0.0)
+
+
+@pytest.fixture
+def data_dir(tmp_path):
+    libsvm.generate_synthetic_ctr(
+        str(tmp_path), num_files=2, examples_per_file=40, feature_size=64,
+        field_size=5, prefix="tr", seed=9)
+    return tmp_path
+
+
+def _files(data_dir):
+    return sorted(str(p) for p in data_dir.glob("*.tfrecords"))
+
+
+def _frames(path):
+    """[(frame_start, payload_len), ...] by walking the length headers."""
+    data = open(path, "rb").read()
+    out, pos = [], 0
+    while pos < len(data):
+        (length,) = struct.unpack_from("<Q", data, pos)
+        out.append((pos, length))
+        pos += 16 + length
+    return out
+
+
+def _flip_byte(path, offset):
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def _corrupt_data_crc(path, record_idx):
+    """Flip a data-CRC byte: framing stays intact, that record is bad."""
+    start, length = _frames(path)[record_idx]
+    _flip_byte(path, start + 12 + length)
+    return start
+
+
+def _corrupt_length_crc(path, record_idx):
+    """Flip a length-CRC byte: framing cannot resync past this record."""
+    start, _ = _frames(path)[record_idx]
+    _flip_byte(path, start + 8)
+    return start
+
+
+def _read(path, native, policy, retry_policy=NO_SLEEP):
+    return list(pipeline._iter_file_records(
+        path, native, True, policy=policy, retry_policy=retry_policy))
+
+
+class TestFlippedDataCrc:
+    @pytest.mark.parametrize("native", NATIVE)
+    def test_raise_names_path_and_offset(self, data_dir, native):
+        path = _files(data_dir)[0]
+        offset = _corrupt_data_crc(path, 7)
+        with pytest.raises(IOError) as ei:
+            _read(path, native, BadRecordPolicy("raise"))
+        msg = str(ei.value)
+        assert path in msg and f"at byte {offset}" in msg
+        assert "data CRC mismatch" in msg
+
+    @pytest.mark.parametrize("native", NATIVE)
+    def test_skip_drops_exactly_one_record(self, data_dir, native):
+        path = _files(data_dir)[0]
+        clean = list(tfrecord.iter_records(path, verify_crc=True))
+        _corrupt_data_crc(path, 7)
+        health = DataHealth()
+        out = _read(path, native, BadRecordPolicy("skip", 0, health))
+        assert out == clean[:7] + clean[8:]
+        snap = health.snapshot()
+        assert snap["bad_records"] == 1
+        assert snap["truncated_tails"] == 0
+        assert snap["per_file"][path]["skipped"] == 1
+
+    def test_skip_parity_between_paths(self, data_dir):
+        if not pipeline._native_loader():
+            pytest.skip("native loader unavailable")
+        path = _files(data_dir)[0]
+        _corrupt_data_crc(path, 3)
+        _corrupt_data_crc(path, 31)
+        results = {}
+        for native in (False, True):
+            health = DataHealth()
+            results[native] = (
+                _read(path, native, BadRecordPolicy("skip", 0, health)),
+                health.snapshot())
+        recs_py, snap_py = results[False]
+        recs_nat, snap_nat = results[True]
+        assert recs_py == recs_nat
+        assert snap_py == snap_nat  # identical counters AND per-file stats
+        assert snap_py["bad_records"] == 2
+
+
+class TestUnrecoverableFraming:
+    @pytest.mark.parametrize("native", NATIVE)
+    def test_truncated_tail_skip(self, data_dir, native):
+        path = _files(data_dir)[0]
+        clean = list(tfrecord.iter_records(path, verify_crc=True))
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(size - 9)  # cuts into the last record's frame
+        health = DataHealth()
+        out = _read(path, native, BadRecordPolicy("skip", 0, health))
+        assert out == clean[:-1]
+        snap = health.snapshot()
+        assert snap["truncated_tails"] == 1
+        assert snap["bad_records"] == 1
+
+    @pytest.mark.parametrize("native", NATIVE)
+    def test_truncated_tail_raise(self, data_dir, native):
+        path = _files(data_dir)[0]
+        last_start, _ = _frames(path)[-1]
+        with open(path, "r+b") as f:
+            f.truncate(os.path.getsize(path) - 9)
+        with pytest.raises(IOError) as ei:
+            _read(path, native, BadRecordPolicy("raise"))
+        assert path in str(ei.value)
+        assert f"at byte {last_start}" in str(ei.value)
+
+    @pytest.mark.parametrize("native", NATIVE)
+    def test_length_crc_discards_tail(self, data_dir, native):
+        """A bad length CRC means the length itself is untrusted — framing
+        cannot resync, so skip mode drops the rest of the file (counted as
+        a truncated tail), not just one record."""
+        path = _files(data_dir)[0]
+        clean = list(tfrecord.iter_records(path, verify_crc=True))
+        offset = _corrupt_length_crc(path, 35)
+        health = DataHealth()
+        out = _read(path, native, BadRecordPolicy("skip", 0, health))
+        assert out == clean[:35]
+        snap = health.snapshot()
+        assert snap["truncated_tails"] == 1
+        with pytest.raises(IOError, match=f"at byte {offset}"):
+            _read(path, native, BadRecordPolicy("raise"))
+
+
+class TestSkipBudget:
+    @pytest.mark.parametrize("native", NATIVE)
+    def test_budget_exceeded_raises(self, data_dir, native):
+        path = _files(data_dir)[0]
+        _corrupt_data_crc(path, 3)
+        _corrupt_data_crc(path, 11)
+        with pytest.raises(IOError, match="bad-record budget exceeded"):
+            _read(path, native, BadRecordPolicy("skip", max_bad=1))
+
+    @pytest.mark.parametrize("native", NATIVE)
+    def test_budget_at_limit_ok(self, data_dir, native):
+        path = _files(data_dir)[0]
+        _corrupt_data_crc(path, 3)
+        _corrupt_data_crc(path, 11)
+        out = _read(path, native, BadRecordPolicy("skip", max_bad=2))
+        assert len(out) == 38
+
+    @pytest.mark.parametrize("native", NATIVE)
+    def test_zero_budget_is_unlimited(self, data_dir, native):
+        path = _files(data_dir)[0]
+        for idx in (1, 5, 9, 13):
+            _corrupt_data_crc(path, idx)
+        out = _read(path, native, BadRecordPolicy("skip", max_bad=0))
+        assert len(out) == 36
+
+
+class TestTransientReadErrors:
+    @pytest.mark.parametrize("native", NATIVE)
+    def test_mid_file_fault_heals_to_clean_output(self, data_dir, native):
+        path = _files(data_dir)[1]
+        clean = list(tfrecord.iter_records(path, verify_crc=True))
+        health = DataHealth()
+        with faults.FlakyFS(read_fail_every=3) as fs:
+            out = _read(path, native, BadRecordPolicy("raise", 0, health))
+        assert out == clean  # healed: no records lost, none duplicated
+        snap = health.snapshot()
+        assert fs.injected_read_faults > 0
+        assert snap["read_retries"] == fs.injected_read_faults
+        assert snap["bad_records"] == 0
+        assert snap["per_file"][path]["retries"] == fs.injected_read_faults
+
+    @pytest.mark.parametrize("native", NATIVE)
+    def test_fault_at_specific_offset_heals(self, data_dir, native):
+        path = _files(data_dir)[1]
+        clean = list(tfrecord.iter_records(path, verify_crc=True))
+        mid_offset = _frames(path)[20][0]
+        health = DataHealth()
+        with faults.FlakyFS(
+                read_fail_offsets=[(os.path.basename(path), mid_offset)]) as fs:
+            out = _read(path, native, BadRecordPolicy("raise", 0, health))
+        assert out == clean
+        assert fs.injected_read_faults == 1
+        assert health.snapshot()["read_retries"] == 1
+
+    @pytest.mark.parametrize("native", NATIVE)
+    def test_combined_transient_plus_corrupt(self, data_dir, native):
+        """The drill scenario in miniature: transient faults heal AND the
+        one corrupt record is skipped; the two fault classes are counted
+        separately."""
+        path = _files(data_dir)[1]
+        clean = list(tfrecord.iter_records(path, verify_crc=True))
+        _corrupt_data_crc(path, 20)
+        health = DataHealth()
+        # Cadence 2: the native path reads whole-file-sized chunks, so a
+        # sparser cadence might never fire on a small test file.
+        with faults.FlakyFS(read_fail_every=2) as fs:
+            out = _read(path, native,
+                        BadRecordPolicy("skip", 0, health))
+        assert out == clean[:20] + clean[21:]
+        snap = health.snapshot()
+        assert snap["read_retries"] == fs.injected_read_faults > 0
+        assert snap["bad_records"] == 1
+
+
+class TestPipelineIntegration:
+    @pytest.mark.parametrize("native", NATIVE)
+    def test_ctr_pipeline_skips_and_reports(self, data_dir, native):
+        files = _files(data_dir)
+        _corrupt_data_crc(files[0], 7)
+
+        def batches(file_list, **kw):
+            p = pipeline.CtrPipeline(
+                file_list, field_size=5, batch_size=16, shuffle=False,
+                shuffle_files=False, drop_remainder=False, verify_crc=True,
+                use_native_decoder=native, prefetch_batches=0,
+                retry_policy=NO_SLEEP, **kw)
+            return list(p), p.health.snapshot()
+
+        out, snap = batches(files, on_bad_record="skip")
+        total = sum(b["label"].shape[0] for b in out)
+        assert total == 79  # 80 records minus the skipped one
+        assert snap["bad_records"] == 1
+
+        with pytest.raises(IOError, match="data CRC mismatch"):
+            batches(files, on_bad_record="raise")
+
+    @pytest.mark.parametrize("native", NATIVE)
+    def test_streaming_pipeline_skips_and_reports(self, data_dir, native):
+        files = _files(data_dir)
+        _corrupt_data_crc(files[0], 7)
+        stream = pipeline.ChainedFileStream(
+            files, num_epochs=1, retry_policy=NO_SLEEP)
+        p = pipeline.StreamingCtrPipeline(
+            stream, field_size=5, batch_size=16, drop_remainder=False,
+            verify_crc=True, use_native_decoder=native, prefetch_batches=0,
+            on_bad_record="skip")
+        total = sum(b["label"].shape[0] for b in p)
+        assert total == 79
+        assert p.health.snapshot()["bad_records"] == 1

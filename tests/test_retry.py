@@ -1,0 +1,206 @@
+"""Retry/backoff policy unit tests (utils/retry.py).
+
+All time is faked — injected sleep recorder + advancing clock — so the whole
+file runs in milliseconds with zero real sleeping.
+"""
+
+import random
+
+import pytest
+
+from deepfm_tpu.utils import retry
+
+pytestmark = pytest.mark.faults
+
+
+class FakeClock:
+    """Monotonic clock that advances only when told (or per sleep)."""
+
+    def __init__(self):
+        self.now = 0.0
+        self.sleeps = []
+
+    def __call__(self):
+        return self.now
+
+    def sleep(self, secs):
+        self.sleeps.append(secs)
+        self.now += secs
+
+
+def _policy(**kw):
+    clock = FakeClock()
+    base = dict(max_attempts=4, base_delay=0.1, max_delay=5.0,
+                sleep=clock.sleep, clock=clock, jitter_seed=0)
+    base.update(kw)
+    return retry.RetryPolicy(**base), clock
+
+
+class Flaky:
+    """Callable failing the first ``n`` calls with ``exc_factory()``."""
+
+    def __init__(self, n, exc_factory=lambda: IOError("transient")):
+        self.failures_left = n
+        self.calls = 0
+        self._exc = exc_factory
+
+    def __call__(self, *args, **kwargs):
+        self.calls += 1
+        if self.failures_left > 0:
+            self.failures_left -= 1
+            raise self._exc()
+        return (args, kwargs)
+
+
+class TestClassification:
+    def test_os_errors_are_retryable(self):
+        for exc in (IOError("x"), ConnectionResetError("x"),
+                    TimeoutError("x"), OSError(5, "EIO")):
+            assert retry.default_is_retryable(exc)
+
+    def test_fatal_os_errors_are_not(self):
+        for exc in (FileNotFoundError("x"), PermissionError("x"),
+                    IsADirectoryError("x"), NotADirectoryError("x"),
+                    FileExistsError("x")):
+            assert not retry.default_is_retryable(exc)
+
+    def test_non_os_errors_are_not(self):
+        for exc in (ValueError("x"), KeyError("x"), RuntimeError("x")):
+            assert not retry.default_is_retryable(exc)
+
+    def test_tf_op_errors_classified_by_name(self):
+        """gfile raises tf.errors.OpError subclasses (not OSErrors); the
+        classifier matches by MRO class name without importing TF."""
+        OpError = type("OpError", (Exception,), {})
+        OpError.__module__ = "tensorflow.python.framework.errors_impl"
+        Unavailable = type("UnavailableError", (OpError,), {})
+        NotFound = type("NotFoundError", (OpError,), {})
+        assert retry.default_is_retryable(Unavailable("conn reset"))
+        assert not retry.default_is_retryable(NotFound("no such object"))
+
+    def test_lookalike_op_error_outside_tf_is_not_retryable(self):
+        OpError = type("OpError", (Exception,), {})
+        OpError.__module__ = "someones.custom.module"
+        assert not retry.default_is_retryable(OpError("nope"))
+
+
+class TestBackoff:
+    def test_full_jitter_bounds(self):
+        pol, _ = _policy(base_delay=0.5, max_delay=4.0)
+        rng = random.Random(123)
+        for attempt in range(8):
+            cap = min(4.0, 0.5 * 2 ** attempt)
+            for _ in range(50):
+                d = pol.backoff_delay(attempt, rng)
+                assert 0.0 <= d <= cap
+
+    def test_jitter_seed_reproducible(self):
+        pol, clock = _policy(max_attempts=4, jitter_seed=7)
+        pol.call(Flaky(3))
+        pol2, clock2 = _policy(max_attempts=4, jitter_seed=7)
+        pol2.call(Flaky(3))
+        assert clock.sleeps == clock2.sleeps
+        assert len(clock.sleeps) == 3
+
+
+class TestCall:
+    def test_success_after_transient_failures(self):
+        pol, clock = _policy(max_attempts=4)
+        fn = Flaky(2)
+        out = pol.call(fn, 1, k=2)
+        assert out == ((1,), {"k": 2})
+        assert fn.calls == 3
+        assert len(clock.sleeps) == 2  # one backoff per healed failure
+
+    def test_gives_up_after_max_attempts(self):
+        pol, clock = _policy(max_attempts=3)
+        fn = Flaky(99)
+        with pytest.raises(IOError, match="failed after 3 attempts"):
+            pol.call(fn, op_name="read(f@0)")
+        assert fn.calls == 3
+        assert len(clock.sleeps) == 2  # no sleep after the final failure
+
+    def test_failure_message_names_the_op(self):
+        pol, _ = _policy(max_attempts=2)
+        with pytest.raises(IOError, match=r"glob\(gs://b/\*\) failed after"):
+            pol.call(Flaky(99), op_name="glob(gs://b/*)")
+
+    def test_non_retryable_propagates_immediately(self):
+        pol, clock = _policy()
+        fn = Flaky(99, lambda: FileNotFoundError("gone"))
+        with pytest.raises(FileNotFoundError):
+            pol.call(fn)
+        assert fn.calls == 1
+        assert clock.sleeps == []
+
+    def test_programming_errors_propagate_immediately(self):
+        pol, clock = _policy()
+        fn = Flaky(99, lambda: ValueError("bug"))
+        with pytest.raises(ValueError):
+            pol.call(fn)
+        assert fn.calls == 1
+        assert clock.sleeps == []
+
+    def test_deadline_stops_retrying(self):
+        pol, clock = _policy(max_attempts=100, base_delay=1.0,
+                             max_delay=1.0, deadline=2.5)
+        fn = Flaky(99)
+        with pytest.raises(IOError, match="failed after deadline"):
+            pol.call(fn)
+        # Attempts stop once the fake clock passes the deadline; with
+        # jittered sleeps in [0, 1] that is far fewer than 100 tries.
+        assert fn.calls < 100
+        assert clock.now >= 2.5
+
+    def test_on_retry_fires_per_healed_failure(self):
+        pol, _ = _policy(max_attempts=4)
+        seen = []
+        pol.call(Flaky(2), on_retry=lambda exc, n: seen.append(n))
+        assert seen == [1, 2]  # 1-based failed-attempt numbers
+
+    def test_on_retry_not_fired_on_final_failure(self):
+        pol, _ = _policy(max_attempts=2)
+        seen = []
+        with pytest.raises(IOError):
+            pol.call(Flaky(99), on_retry=lambda exc, n: seen.append(n))
+        assert seen == [1]
+
+    def test_with_returns_modified_copy(self):
+        pol, _ = _policy(max_attempts=4)
+        pol2 = pol.with_(max_attempts=9)
+        assert pol2.max_attempts == 9 and pol.max_attempts == 4
+        assert pol2.sleep is pol.sleep
+
+
+class TestDecorator:
+    def test_retrying_decorator(self):
+        pol, clock = _policy(max_attempts=3)
+        state = {"left": 2}
+
+        @retry.retrying(pol, op_name="fetch")
+        def fetch(x):
+            if state["left"] > 0:
+                state["left"] -= 1
+                raise IOError("flaky")
+            return x * 2
+
+        assert fetch(21) == 42
+        assert len(clock.sleeps) == 2
+        assert fetch.__name__ == "fetch"
+
+
+class TestPolicyFromConfig:
+    def test_reads_config_knobs(self):
+        from deepfm_tpu.config import Config
+        cfg = Config(io_retries=7, io_retry_backoff_secs=0.25,
+                     io_retry_deadline_secs=30.0)
+        pol = retry.policy_from_config(cfg)
+        assert pol.max_attempts == 7
+        assert pol.base_delay == 0.25
+        assert pol.deadline == 30.0
+
+    def test_zero_deadline_means_none(self):
+        from deepfm_tpu.config import Config
+        pol = retry.policy_from_config(Config())
+        assert pol.deadline is None
+        assert pol.max_attempts >= 1
