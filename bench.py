@@ -153,18 +153,53 @@ def host_stage_series() -> dict:
                 b, 39, verify_crc=False) for b in bufs])
             out["decode_ns_per_record"] = round(1e9 * dt / n_records, 1)
 
-        def run_pipeline():
-            pipe = CtrPipeline(
+        def make_pipe(**kw):
+            return CtrPipeline(
                 files, field_size=39, batch_size=1024, num_epochs=1,
                 shuffle=True, shuffle_files=True, drop_remainder=True,
-                seed=0)
-            n = 0
-            for rows, m, n_ex in pipe.iter_superbatches(K_STEPS):
-                n += n_ex
-            return n
+                seed=0, **kw)
 
-        dt = best_of(run_pipeline)
-        out["staged_pipeline_ns_per_record"] = round(1e9 * dt / n_records, 1)
+        def staged_ns(trials=3, **kw):
+            """Best-of-N ns/record of the full staged pipeline. The
+            pipeline is built OUTSIDE the timed region (construction is
+            not staging cost) and the denominator is the record count the
+            pipeline actually returned — drop_remainder eats the tail, so
+            dividing by the on-disk count understated the per-record cost
+            (advisor r5, both)."""
+            best, n = float("inf"), 0
+            for _ in range(trials):
+                pipe = make_pipe(**kw)  # single-use: fresh per trial
+                t0 = time.perf_counter()
+                n = sum(n_ex for _, _, n_ex
+                        in pipe.iter_superbatches(K_STEPS))
+                best = min(best, time.perf_counter() - t0)
+            return round(1e9 * best / max(n, 1), 1), n
+
+        out["staged_pipeline_ns_per_record"], n_staged = staged_ns()
+        out["staged_records_returned"] = n_staged
+
+        if loader.available():
+            # Worker path: decode in 2 processes feeding shared-memory
+            # slabs. On a multi-core host this should beat the in-process
+            # series; on a 1-core host it mostly measures IPC overhead —
+            # report both and let the reader compare against nproc.
+            out["staged_workers2_ns_per_record"], _ = staged_ns(
+                input_workers=2)
+            out["host_cores"] = os.cpu_count()
+
+            def stream_hash(**kw):
+                import hashlib
+                h = hashlib.blake2b(digest_size=12)
+                for rows, m, n_ex in make_pipe(**kw).iter_superbatches(
+                        K_STEPS):
+                    for key in ("label", "feat_ids", "feat_vals"):
+                        h.update(rows[key].tobytes())
+                return h.hexdigest()
+
+            # Same-seed parity: the worker path must emit the bit-identical
+            # batch stream (same records, same shuffle, same grouping).
+            out["worker_parity_bit_identical"] = (
+                stream_hash() == stream_hash(input_workers=2))
     return out
 
 

@@ -82,6 +82,12 @@ class Config:
     drop_remainder: bool = True
     prefetch_batches: int = 4
     reader_threads: int = 4           # host decode parallelism (MKL/OMP analog)
+    # Decode worker PROCESSES feeding shared-memory slabs (0 = in-process
+    # decode). Threads stop helping once the GIL-bound shuffle/stage work
+    # dominates; processes sidestep the GIL entirely (see TUNING.md
+    # "input_workers vs reader_threads"). Needs the native decoder; batch
+    # order is bit-identical to the in-process path at equal seeds.
+    input_workers: int = 0
     use_native_decoder: bool = True   # C++ TFRecord decode path
     # CRC32C-check every record. Default False for speed: skipping the
     # check buys ~15-20% host decode throughput on a 1-core host (TUNING.md).
@@ -163,6 +169,8 @@ class Config:
                 f"got {self.on_bad_record!r}")
         if self.max_bad_records < 0:
             raise ValueError("max_bad_records must be >= 0")
+        if self.input_workers < 0:
+            raise ValueError("input_workers must be >= 0")
         if self.io_retries < 1:
             raise ValueError("io_retries must be >= 1")
         if self.io_retry_backoff_secs < 0 or self.io_retry_deadline_secs < 0:

@@ -65,6 +65,26 @@ class DataHealth:
                 "per_file": {k: dict(v) for k, v in self.per_file.items()},
             }
 
+    def apply_delta(self, delta: Dict[str, object]) -> None:
+        """Add a snapshot-shaped increment into these counters — the
+        cross-process merge used by the input service (workers send
+        cumulative snapshots; the parent applies successive differences,
+        so restransmission-free aggregation stays exact)."""
+        with self._lock:
+            changed = False
+            for key in ("read_retries", "bad_records", "truncated_tails",
+                        "bytes_discarded"):
+                inc = int(delta.get(key, 0))  # type: ignore[arg-type]
+                if inc:
+                    setattr(self, key, getattr(self, key) + inc)
+                    changed = True
+            for path, c in delta.get("per_file", {}).items():  # type: ignore[union-attr]
+                entry = self._file(path)
+                for k in ("retries", "skipped"):
+                    entry[k] += int(c.get(k, 0))
+                changed = changed or any(c.values())
+            self._dirty = self._dirty or changed
+
     def merge_into(self, totals: Dict[str, int]) -> None:
         """Accumulate scalar counters into ``totals`` (for cross-epoch sums)."""
         snap = self.snapshot()

@@ -218,6 +218,31 @@ def _group_plain_batches(batches: Iterator[Batch], k: int, bs: int
         yield g, 1, bs
 
 
+class _DrainPool:
+    """Lazily-created drain-decode thread pool, owned by ONE iterator.
+
+    Persistent across every pool drain of that iterator (spawn/join per
+    drain would recur every shuffle_buffer records), but private to it: a
+    pipeline-shared executor let one iterator's epoch-end release kill a
+    concurrent iterator's in-flight drain (advisor r5).
+    """
+
+    def __init__(self, n_threads: int):
+        self._n = n_threads
+        self._ex = None
+
+    def get(self):
+        if self._ex is None:
+            from concurrent.futures import ThreadPoolExecutor  # noqa: PLC0415
+            self._ex = ThreadPoolExecutor(self._n)
+        return self._ex
+
+    def shutdown(self) -> None:
+        if self._ex is not None:
+            self._ex.shutdown(wait=False)
+            self._ex = None
+
+
 class CtrPipeline:
     """TFRecord CTR input pipeline producing fixed-shape numpy batches."""
 
@@ -243,6 +268,9 @@ class CtrPipeline:
         on_bad_record: str = "raise",
         max_bad_records: int = 0,
         retry_policy=None,
+        input_workers: int = 0,
+        input_worker_slab_records: Optional[int] = None,
+        input_worker_death: str = "raise",
     ):
         if shard is not None:
             self._files: Tuple[str, ...] = shard.files
@@ -282,7 +310,15 @@ class CtrPipeline:
         # k-pooled stream, whose batch order differs past the first drain.
         self.skip_batches = skip_batches
         self._decode = _get_decoder(use_native_decoder)
-        self._scatter_pool = None  # lazy drain-decode executor (see close())
+        # Multi-process input service (opt-in, see workers.py): decode
+        # worker processes feed shared-memory slabs; 0 = in-process decode
+        # (the default path, byte-for-byte unchanged). Engaged only where
+        # its determinism contract holds: native decoder present and no
+        # record-level shard (workers see per-file streams, not global
+        # record indices).
+        self.input_workers = max(0, int(input_workers))
+        self.input_worker_slab_records = input_worker_slab_records
+        self.input_worker_death = input_worker_death
         # Fault tolerance: one DataHealth/BadRecordPolicy pair per pipeline
         # (skip budget spans every epoch of this pipeline's life); the
         # retry policy governs opens + mid-file reopen-and-seek healing.
@@ -334,6 +370,42 @@ class CtrPipeline:
         for rows, _, _ in self._iter_pooled(loader, 1):
             yield rows
 
+    def _epoch_files(self, epoch: int) -> List[str]:
+        """THE per-epoch file order: deterministic seeded reshuffle
+        (reference shuffles the file list once at :373-377; here it varies
+        per epoch). Single source shared by the record path, the chunk
+        paths, and the input-service worker assignment — worker-path batch
+        reproducibility rests on all of them agreeing on this order."""
+        files = list(self._files)
+        if self.shuffle_files:
+            np.random.default_rng(self.seed + epoch).shuffle(files)
+        return files
+
+    def _make_input_service(self, epoch: int):
+        """Spawn the decode-worker fleet for one epoch, or None to fall
+        back in-process (service start can fail where spawn or POSIX shm
+        is restricted — the pipeline must degrade, not die)."""
+        from . import workers  # noqa: PLC0415 (keeps module import light)
+        try:
+            return workers.ShmInputService(
+                self._epoch_files(epoch),
+                field_size=self.field_size,
+                num_workers=self.input_workers,
+                slab_records=self.input_worker_slab_records,
+                verify_crc=self.verify_crc,
+                on_bad_record=self._bad_policy.on_bad,
+                max_bad_records=self._bad_policy.max_bad,
+                retry_policy=self._retry_policy,
+                health=self.health,
+                on_worker_death=self.input_worker_death,
+            ).start()
+        except Exception as exc:
+            import warnings  # noqa: PLC0415
+            warnings.warn(
+                f"input service unavailable ({exc!r}); falling back to "
+                f"in-process decode", RuntimeWarning, stacklevel=2)
+            return None
+
     def _iter_framed_span_chunks(self, epoch: int, loader
                                  ) -> Iterator[Tuple[bytes, np.ndarray,
                                                      np.ndarray]]:
@@ -343,9 +415,7 @@ class CtrPipeline:
         semantics, and shard selection for the pooled paths —
         ``_iter_decoded_chunks`` consumes this same stream, so the fused
         (decode-at-drain) and eager-decode emissions cannot drift apart."""
-        files = list(self._files)
-        if self.shuffle_files:
-            np.random.default_rng(self.seed + epoch).shuffle(files)
+        files = self._epoch_files(epoch)
         n_seen = 0
         got_any = False
         for path in files:
@@ -369,26 +439,16 @@ class CtrPipeline:
         if not got_any and files:
             raise IOError(f"no records found in {len(files)} files")
 
-    def _scatter_pool_executor(self):
-        """Persistent drain-decode thread pool (one per pipeline, not one
-        per drain — spawn/join per pool window would recur every
-        shuffle_buffer records). Released by close() / end of iteration."""
-        if self._scatter_pool is None:
-            from concurrent.futures import ThreadPoolExecutor  # noqa: PLC0415
-            self._scatter_pool = ThreadPoolExecutor(self.reader_threads)
-        return self._scatter_pool
-
     def close(self) -> None:
-        """Release the drain-decode executor. Iteration paths release it
-        in-line when they finish; this covers abandoned iterators (the
-        train loop calls close() on sources it drops mid-stream)."""
-        if self._scatter_pool is not None:
-            self._scatter_pool.shutdown(wait=False)
-            self._scatter_pool = None
+        """Kept for API compatibility: the drain-decode executor is now
+        per-iterator (``_DrainPool``), owned and released by each
+        ``_iter_pooled_raw`` generator — a second live iterator of the
+        same pipeline no longer loses its pool when the first one ends
+        an epoch (advisor r5)."""
 
     def _scatter_decode_raw(self, loader, raw, perm: np.ndarray, off: int,
                             labels: np.ndarray, ids: np.ndarray,
-                            vals: np.ndarray) -> None:
+                            vals: np.ndarray, pool: "_DrainPool") -> None:
         """Decode every raw span chunk straight into its permuted pool rows
         (``loader.decode_spans_scatter``). Rows are disjoint across chunks
         and the C call releases the GIL, so chunks decode on the reader
@@ -415,7 +475,7 @@ class CtrPipeline:
             for job in jobs:
                 run(job)
         else:
-            list(self._scatter_pool_executor().map(run, jobs))
+            list(pool.get().map(run, jobs))
 
     def _iter_pooled(self, loader, k: int
                      ) -> Iterator[Tuple[Batch, int, int]]:
@@ -439,6 +499,16 @@ class CtrPipeline:
         from (seed, epoch + epoch_offset) exactly like the record path."""
         bs = self.batch_size
         sb = bs * max(k, 1)
+        # Multi-process path (opt-in): decode runs in worker processes and
+        # this generator pools zero-copy shared-memory views. The chunk
+        # stream the service yields is exactly the in-process
+        # ``_iter_decoded_chunks`` stream (same files, order, chunk
+        # boundaries), so pooling it through the eager branch below emits
+        # bit-identical batches — the parity the bench asserts. Disabled
+        # under record-sharding (workers see per-file streams, not the
+        # global record index the 1/world filter needs).
+        use_shm = (self.input_workers > 0 and loader is not None
+                   and self._record_shard is None)
         # Fused scatter-decode (r5): with shuffle on and the native decoder
         # available, the proto decode is DEFERRED to drain time and each
         # record decodes straight into its permuted pool row — one pass per
@@ -452,57 +522,81 @@ class CtrPipeline:
         # filter those buffers hold ~world x the rows that count toward
         # pool_target — a world-fold RSS regression; the eager path decodes
         # (only) the kept rows and frees each buffer immediately.
-        fused = (self.shuffle and loader is not None
+        fused = (not use_shm and self.shuffle and loader is not None
                  and self._record_shard is None
                  and hasattr(loader, "decode_spans_scatter"))
-        for e in range(self.num_epochs):
-            epoch = e + self.epoch_offset
-            rng = np.random.default_rng(self.seed * 1_000_003 + epoch)
-            pool_target = max(self.shuffle_buffer, sb) if self.shuffle else sb
-            pend: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
-            raw: List[Tuple[bytes, np.ndarray, np.ndarray]] = []
-            n_pend = 0
+        # Drain-decode executor: per-ITERATOR, not per-pipeline — two live
+        # iterators of one pipeline must not share (advisor r5: the first
+        # one's epoch-end close() killed the second's in-flight drain).
+        drain_pool = _DrainPool(self.reader_threads)
+        try:
+            for e in range(self.num_epochs):
+                epoch = e + self.epoch_offset
+                rng = np.random.default_rng(self.seed * 1_000_003 + epoch)
+                pool_target = (max(self.shuffle_buffer, sb)
+                               if self.shuffle else sb)
+                pend: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+                raw: List[Tuple[bytes, np.ndarray, np.ndarray]] = []
+                n_pend = 0
+                service = self._make_input_service(epoch) if use_shm else None
 
-            def drain(final: bool) -> Iterator[Tuple[Batch, int, int]]:
-                nonlocal pend, raw, n_pend
-                if self.shuffle and n_pend > 0 and (pend or raw):
-                    # Single-scatter permutation: each row lands at its
-                    # shuffled destination in ONE preallocated pool write
-                    # (vs concatenate-then-gather = two full copies).
-                    # Uniform: row j goes to position perm[j] of a full
-                    # permutation. The drain-remainder (pend, already
-                    # decoded) scatters first, then raw chunks decode
-                    # directly to their rows — matching the arrival order
-                    # the permutation indexes.
-                    perm = rng.permutation(n_pend)
-                    labels = np.empty((n_pend,), np.float32)
-                    ids = np.empty((n_pend, self.field_size), np.int32)
-                    vals = np.empty((n_pend, self.field_size), np.float32)
-                    off = 0
-                    for lab, idx, val in pend:
-                        dest = perm[off:off + len(lab)]
-                        labels[dest] = lab
-                        ids[dest] = idx
-                        vals[dest] = val
-                        off += len(lab)
-                    if raw:
-                        self._scatter_decode_raw(
-                            loader, raw, perm, off, labels, ids, vals)
-                    pend = [(labels, ids, vals)]
-                    raw = []
-                while n_pend >= sb:
-                    yield self._assemble_batch(pend, sb), k, sb
-                    n_pend -= sb
-                if final:
-                    while n_pend >= bs:
-                        yield self._assemble_batch(pend, bs), 1, bs
-                        n_pend -= bs
-                    if n_pend and not self.drop_remainder:
-                        yield self._assemble_batch(pend, n_pend), 1, n_pend
-                        n_pend = 0
+                def drain(final: bool, service=service
+                          ) -> Iterator[Tuple[Batch, int, int]]:
+                    nonlocal pend, raw, n_pend
+                    if self.shuffle and n_pend > 0 and (pend or raw):
+                        # Single-scatter permutation: each row lands at its
+                        # shuffled destination in ONE preallocated pool write
+                        # (vs concatenate-then-gather = two full copies).
+                        # Uniform: row j goes to position perm[j] of a full
+                        # permutation. The drain-remainder (pend, already
+                        # decoded) scatters first, then raw chunks decode
+                        # directly to their rows — matching the arrival order
+                        # the permutation indexes.
+                        perm = rng.permutation(n_pend)
+                        labels = np.empty((n_pend,), np.float32)
+                        ids = np.empty((n_pend, self.field_size), np.int32)
+                        vals = np.empty((n_pend, self.field_size), np.float32)
+                        off = 0
+                        for lab, idx, val in pend:
+                            dest = perm[off:off + len(lab)]
+                            labels[dest] = lab
+                            ids[dest] = idx
+                            vals[dest] = val
+                            off += len(lab)
+                        if raw:
+                            self._scatter_decode_raw(
+                                loader, raw, perm, off, labels, ids, vals,
+                                drain_pool)
+                        pend = [(labels, ids, vals)]
+                        raw = []
+                        if service is not None:
+                            # Every held slab view has been scattered into
+                            # the fresh pool arrays above — hand the slots
+                            # back so workers refill them while we slice.
+                            service.release_consumed()
+                    while n_pend >= sb:
+                        yield self._assemble_batch(pend, sb), k, sb
+                        n_pend -= sb
+                    if final:
+                        while n_pend >= bs:
+                            yield self._assemble_batch(pend, bs), 1, bs
+                            n_pend -= bs
+                        if n_pend and not self.drop_remainder:
+                            yield self._assemble_batch(pend, n_pend), 1, n_pend
+                            n_pend = 0
 
-            try:
-                if fused:
+                if service is not None:
+                    with service:
+                        # shuffle=False never scatters, so views would stay
+                        # referenced by batch slices indefinitely: copy out
+                        # of the slabs instead of holding them.
+                        for chunk in service.chunks(copy=not self.shuffle):
+                            pend.append(chunk)
+                            n_pend += len(chunk[0])
+                            if n_pend >= pool_target:
+                                yield from drain(final=False)
+                        yield from drain(final=True)
+                elif fused:
                     for span in self._iter_framed_span_chunks(epoch, loader):
                         raw.append(span)
                         n_pend += len(span[1])
@@ -516,12 +610,11 @@ class CtrPipeline:
                         if n_pend >= pool_target:
                             yield from drain(final=False)
                     yield from drain(final=True)
-            finally:
-                # Release the drain-decode executor at epoch end AND on an
-                # abandoned generator (GeneratorExit lands here). Within an
-                # epoch the executor persists across every pool drain; the
-                # one spawn per epoch is noise.
-                self.close()
+        finally:
+            # Release the drain-decode executor when the generator ends OR
+            # is abandoned (GeneratorExit lands here). It persists across
+            # every pool drain of every epoch of THIS iterator.
+            drain_pool.shutdown()
 
     def iter_superbatches(self, k: int
                           ) -> Iterator[Tuple[Batch, int, int]]:
@@ -580,11 +673,7 @@ class CtrPipeline:
 
     # ------------------------------------------------------------------
     def _iter_raw_records(self, epoch: int) -> Iterator[bytes]:
-        files = list(self._files)
-        if self.shuffle_files:
-            # Per-epoch reshuffle, seeded: deterministic but epoch-varying
-            # (reference shuffles the file list once at :373-377).
-            np.random.default_rng(self.seed + epoch).shuffle(files)
+        files = self._epoch_files(epoch)
         n_seen = 0
         for path in files:
             for rec in _iter_file_records(path, self._use_native,

@@ -221,6 +221,20 @@ def decode_spans_scatter(buf, offsets: np.ndarray, lengths: np.ndarray,
     offsets = np.ascontiguousarray(offsets, dtype=np.int64)
     lengths = np.ascontiguousarray(lengths, dtype=np.int64)
     dest = np.ascontiguousarray(dest, dtype=np.int64)
+    # The C side scatters unchecked (labels[dest[i]] etc.) — a caller bug
+    # here is silent out-of-bounds heap writes, so validate the index
+    # vector before handing over the pointers (advisor r5).
+    if len(dest) != n:
+        raise ValueError(
+            f"decode_spans_scatter: len(dest)={len(dest)} != "
+            f"len(offsets)={n}")
+    rows = min(len(labels), len(ids), len(vals))
+    if n and (int(dest.min()) < 0 or int(dest.max()) >= rows):
+        raise ValueError(
+            f"decode_spans_scatter: dest range [{int(dest.min())}, "
+            f"{int(dest.max())}] outside pool of {rows} rows")
+    if n == 0:
+        return
     detail = ctypes.c_long(0)
     rc = lib.dfm_decode_ctr_scatter(
         _as_ubyte_ptr(buf),

@@ -343,9 +343,12 @@ class Trainer:
     def _make_eval_multi_step(self) -> Callable:
         """K weighted eval updates in ONE dispatch: lax.scan over stacked
         [K, B, ...] batches (the eval twin of ``multi_step``, VERDICT r3
-        #2). The scan merges into the accumulator in batch order, so the
-        result is bit-identical to K sequential ``eval_step`` calls — only
-        the per-batch host dispatch + transfer overhead is amortized."""
+        #2). The scan merges into the accumulator in batch order; on CPU
+        that reproduces K sequential ``eval_step`` calls bit-for-bit (the
+        property the tests pin), while on TPU the scanned program may fuse
+        or reassociate float reductions differently, so expect agreement
+        to rounding there, not bit-identity. Only the per-batch host
+        dispatch + transfer overhead is amortized."""
         mi = self.mesh_info
         shard_axis = mi.model_axis if mi.model_size > 1 else None
         data_axis = mi.data_axis
@@ -534,8 +537,9 @@ class Trainer:
 
         Yields ``(staged, group)``: ``staged`` is the [k,B,...] device
         superbatch for full rounds (None for short ones), ``group`` the
-        host batches — retained so a globally-short final round can
-        re-dispatch a prefix of single steps. One short round ends the
+        host batches — retained so a rank that turns out globally short
+        can transfer the agreed prefix (a staged rank slices its device
+        superbatch instead). One short round ends the
         stream (source exhausted). The np.stack in ``put_superbatch`` (vs
         the single-process zero-copy ``iter_superbatches`` feed) is the
         price of the lockstep protocol — the min-truncate exchange needs
@@ -595,11 +599,35 @@ class Trainer:
                 if m == k and staged is not None:
                     n_ex = sum(g["label"].shape[0] for g in group)
                     yield staged, k, n_ex
-                else:
-                    # Globally-short final round: re-dispatch the agreed
-                    # prefix as single steps (no recompile for odd sizes).
-                    for b in group[:m]:
-                        yield self.put_batch(b), 1, b["label"].shape[0]
+                elif m > 0:
+                    # Globally-short final round. Every rank must dispatch
+                    # the SAME program sequence (the step programs are
+                    # global collectives), so all ranks emit ONE m-step
+                    # group: ranks that already transferred a full [k,B]
+                    # superbatch slice its prefix ON DEVICE (advisor r5 —
+                    # previously the staged transfer was discarded and the
+                    # prefix re-transferred batch-by-batch), short ranks
+                    # transfer just their m batches. m == 1 lands on the
+                    # single-step program every rank has already compiled;
+                    # m > 1 costs one tail-of-training compile of the
+                    # [m,B] scan. The slice is collective-free, so only
+                    # staged ranks running it cannot desync the mesh.
+                    n_ex = sum(g["label"].shape[0] for g in group[:m])
+                    if staged is not None and k > 1:
+                        if m == 1:
+                            dev = jax.jit(
+                                lambda d: {key: v[0] for key, v in d.items()}
+                            )(staged)
+                        else:
+                            dev = jax.jit(
+                                lambda d, _m=m: {key: v[:_m]
+                                                 for key, v in d.items()}
+                            )(staged)
+                        yield dev, m, n_ex
+                    elif m == 1:
+                        yield self.put_batch(group[0]), 1, n_ex
+                    else:
+                        yield self.put_superbatch(group[:m]), m, n_ex
                 if m < k:
                     if len(group) > m:
                         ulog.warning(

@@ -178,6 +178,7 @@ def make_pipeline(cfg: Config, files: List[str], *, epochs: int = 1,
         prefetch_batches=cfg.prefetch_batches,
         use_native_decoder=cfg.use_native_decoder,
         reader_threads=cfg.reader_threads,
+        input_workers=cfg.input_workers,
         verify_crc=cfg.verify_crc,
         **_fault_tolerance_kwargs(cfg),
     )

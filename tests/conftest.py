@@ -32,3 +32,8 @@ def pytest_configure(config):
         "clock/sleep — no real backoff sleeps)")
     config.addinivalue_line(
         "markers", "slow: long-running tests excluded from tier-1")
+    config.addinivalue_line(
+        "markers",
+        "input_service: multi-process shared-memory input service tests "
+        "(slab ring protocol in-process; worker-fleet tests spawn real "
+        "processes)")

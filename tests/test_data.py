@@ -793,6 +793,29 @@ class TestPooledEmissionGolden:
 
     @pytest.mark.skipif(not pipeline._native_loader(),
                         reason="native decoder unavailable")
+    def test_two_live_iterators_do_not_share_drain_pool(self, golden_files,
+                                                        monkeypatch):
+        """Two concurrent iterators of ONE pipeline: the drain-decode
+        executor is per-iterator, so the first iterator finishing its run
+        (its cleanup used to be pipeline-level close(), killing the shared
+        pool) must not break the second's still-threaded drains."""
+        monkeypatch.setattr(pipeline, "_SCATTER_SPLIT_MIN", 100)
+        pipe = pipeline.CtrPipeline(
+            golden_files, field_size=7, batch_size=64, num_epochs=1,
+            shuffle=True, shuffle_files=True, shuffle_buffer=300,
+            drop_remainder=True, seed=9, prefetch_batches=0)
+        pipe.reader_threads = 3
+        first = pipe.iter_superbatches(4)
+        second = pipe.iter_superbatches(4)
+        next(second)  # second is mid-epoch with drains pending...
+        exhausted = sum(1 for _ in first)  # ...when first fully finishes
+        rest = sum(1 for _ in second)
+        # Both iterators see the complete, identical emission count (same
+        # pipeline state, same seed => same stream).
+        assert exhausted == rest + 1
+
+    @pytest.mark.skipif(not pipeline._native_loader(),
+                        reason="native decoder unavailable")
     def test_parallel_scatter_decode_identical(self, golden_files,
                                                monkeypatch):
         """The multi-threaded drain decode (reader_threads > 1, chunks split

@@ -141,3 +141,69 @@ def test_chunked_reader_truncated_file_errors(sample_file, tmp_path):
     bad.write_bytes(buf[:-7])  # cut inside the final record
     with pytest.raises(IOError):
         list(pipeline._iter_file_records(str(bad), use_native=True))
+
+
+class TestDecodeSpansScatterValidation:
+    """The C scatter writes labels[dest[i]] unchecked — these guards are the
+    only thing between a caller bug and silent out-of-bounds heap writes."""
+
+    def _spans(self, sample_file, n=10):
+        buf = open(sample_file, "rb").read()
+        offsets, lengths = loader.split_frames(buf)
+        return buf, offsets[:n], lengths[:n]
+
+    def _pool(self, rows):
+        return (np.empty(rows, np.float32), np.empty((rows, 7), np.int32),
+                np.empty((rows, 7), np.float32))
+
+    def test_scatter_matches_gather_paths(self, sample_file):
+        buf, offsets, lengths = self._spans(sample_file)
+        labels, ids, vals = self._pool(10)
+        dest = np.arange(10, dtype=np.int64)[::-1].copy()  # reversed rows
+        loader.decode_spans_scatter(buf, offsets, lengths, 7, dest,
+                                    labels, ids, vals)
+        recs = tfrecord.read_all_records(sample_file)[:10]
+        l_ref, i_ref, v_ref = loader.decode_batch(recs, 7)
+        np.testing.assert_array_equal(labels, l_ref[::-1])
+        np.testing.assert_array_equal(ids, i_ref[::-1])
+        np.testing.assert_array_equal(vals, v_ref[::-1])
+
+    def test_dest_length_mismatch_raises(self, sample_file):
+        buf, offsets, lengths = self._spans(sample_file)
+        labels, ids, vals = self._pool(10)
+        with pytest.raises(ValueError, match="len\\(dest\\)"):
+            loader.decode_spans_scatter(
+                buf, offsets, lengths, 7, np.arange(9, dtype=np.int64),
+                labels, ids, vals)
+
+    def test_dest_out_of_bounds_raises(self, sample_file):
+        buf, offsets, lengths = self._spans(sample_file)
+        labels, ids, vals = self._pool(10)
+        dest = np.arange(10, dtype=np.int64)
+        dest[3] = 10  # == rows: one past the end
+        with pytest.raises(ValueError, match="dest range"):
+            loader.decode_spans_scatter(buf, offsets, lengths, 7, dest,
+                                        labels, ids, vals)
+        dest[3] = -1
+        with pytest.raises(ValueError, match="dest range"):
+            loader.decode_spans_scatter(buf, offsets, lengths, 7, dest,
+                                        labels, ids, vals)
+
+    def test_bounds_use_smallest_pool_array(self, sample_file):
+        """A short vals array shrinks the valid dest range: the guard must
+        bound by min(len) across the three pools, not just labels."""
+        buf, offsets, lengths = self._spans(sample_file)
+        labels = np.empty(10, np.float32)
+        ids = np.empty((10, 7), np.int32)
+        vals = np.empty((9, 7), np.float32)  # one row short
+        with pytest.raises(ValueError, match="dest range"):
+            loader.decode_spans_scatter(
+                buf, offsets, lengths, 7, np.arange(10, dtype=np.int64),
+                labels, ids, vals)
+
+    def test_empty_spans_noop(self, sample_file):
+        buf, _, _ = self._spans(sample_file)
+        labels, ids, vals = self._pool(4)
+        loader.decode_spans_scatter(
+            buf, np.empty(0, np.int64), np.empty(0, np.int64), 7,
+            np.empty(0, np.int64), labels, ids, vals)

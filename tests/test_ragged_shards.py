@@ -229,3 +229,30 @@ def test_ragged_streaming_train(ragged_workdir):
     assert results[0]["steps"] == 4  # min(6, 4)
     assert results[0]["loss"] == pytest.approx(results[1]["loss"], abs=1e-6)
     assert results[0]["auc"] == pytest.approx(results[1]["auc"], abs=1e-6)
+
+
+@pytest.mark.slow
+def test_short_round_slices_staged_superbatch(ragged_workdir):
+    """steps_per_loop LARGER than the shortest rank's batch count: in the
+    one-and-only round, rank0 has already transferred a full [3,B] device
+    superbatch when the count exchange agrees on m=2 — it must slice the
+    staged prefix ON DEVICE (collective-free jit) while rank1 transfers its
+    2 host batches, and both dispatch the same [2,B] scan program. A wrong
+    program shape on either rank deadlocks (timeout); wrong data breaks the
+    replicated-metric agreement.
+
+    Marked slow: like this module's other 2-OS-process tests it needs a
+    working cross-process collectives backend (TPU pod, or CPU with a
+    functional gloo build) and cannot run on hosts where jaxlib's CPU
+    client has no collectives implementation."""
+    args = _base_args(ragged_workdir, _free_port()) + [
+        "--task_type", "train",
+        "--model_dir", str(ragged_workdir / "ckpt_slice"),
+        "--num_epochs", "1",
+        "--steps_per_loop", "3",
+    ]
+    results = _run_two_procs(args)
+    # min-truncated: rank1 holds 64/32 = 2 local batches.
+    assert results[0]["steps"] == 2
+    assert results[0]["loss"] == pytest.approx(results[1]["loss"], abs=1e-6)
+    assert results[0]["auc"] == pytest.approx(results[1]["auc"], abs=1e-6)

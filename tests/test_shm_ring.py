@@ -1,0 +1,227 @@
+"""Shared-memory slab ring unit tests (data/shm_ring.py).
+
+All protocol mechanics — layout, wraparound, backpressure, out-of-order
+release — run in-process with thread queues (``THREAD_CTX``), so the file
+is deterministic and sleep-free (the test_retry.py discipline: no real
+waiting, every timeout is a non-blocking probe). Process-boundary behavior
+is covered by tests/test_input_workers.py.
+"""
+
+import numpy as np
+import pytest
+
+from deepfm_tpu.data import shm_ring
+
+pytestmark = pytest.mark.input_service
+
+
+def _ring(slab_records=8, field_size=3, capacity=2):
+    spec = shm_ring.SlabSpec(slab_records, field_size)
+    return shm_ring.ShmRing.create(spec, capacity, shm_ring.THREAD_CTX)
+
+
+class TestSlabSpec:
+    def test_layout_bytes(self):
+        spec = shm_ring.SlabSpec(slab_records=10, field_size=4)
+        assert spec.labels_bytes == 40
+        assert spec.ids_bytes == 160
+        assert spec.slab_bytes == 40 + 160 + 160
+
+    def test_rejects_degenerate_geometry(self):
+        with pytest.raises(ValueError):
+            shm_ring.SlabSpec(0, 4)
+        with pytest.raises(ValueError):
+            shm_ring.SlabSpec(8, 0)
+
+    def test_capacity_floor(self):
+        spec = shm_ring.SlabSpec(8, 3)
+        with pytest.raises(ValueError, match="capacity"):
+            shm_ring.ShmRing.create(spec, 1, shm_ring.THREAD_CTX)
+
+
+class TestSlabArrays:
+    def test_views_alias_the_segment(self):
+        ring = _ring()
+        try:
+            labels, ids, vals = ring.arrays(0, 5)
+            labels[:] = np.arange(5, dtype=np.float32)
+            ids[:] = 7
+            vals[:] = 0.5
+            lab2, ids2, vals2 = ring.arrays(0, 5)
+            np.testing.assert_array_equal(
+                lab2, np.arange(5, dtype=np.float32))
+            assert ids2.shape == (5, 3) and (ids2 == 7).all()
+            assert (vals2 == 0.5).all()
+            del labels, ids, vals, lab2, ids2, vals2
+        finally:
+            ring.close()
+
+    def test_slots_do_not_overlap(self):
+        ring = _ring(slab_records=4, field_size=2, capacity=3)
+        try:
+            for slot in range(3):
+                lab, ids, vals = ring.arrays(slot, 4)
+                lab[:] = slot
+                ids[:] = slot
+                vals[:] = slot
+                del lab, ids, vals
+            for slot in range(3):
+                lab, ids, vals = ring.arrays(slot, 4)
+                assert (lab == slot).all() and (ids == slot).all() \
+                    and (vals == slot).all()
+                del lab, ids, vals
+        finally:
+            ring.close()
+
+    def test_bounds_checked(self):
+        ring = _ring(slab_records=8, capacity=2)
+        try:
+            with pytest.raises(IndexError):
+                ring.arrays(2, 1)
+            with pytest.raises(ValueError):
+                ring.arrays(0, 9)  # more rows than a slab holds
+            with pytest.raises(IndexError):
+                ring.release(5)
+        finally:
+            ring.close()
+
+
+class TestCreditProtocol:
+    def test_all_slots_preloaded_free(self):
+        ring = _ring(capacity=3)
+        try:
+            got = {ring.acquire(timeout=0) for _ in range(3)}
+            assert got == {0, 1, 2}
+            assert ring.acquire(timeout=0) is None
+        finally:
+            ring.close()
+
+    def test_backpressure_when_consumer_stalls(self):
+        """Producer drains the free list and gets None (would block in
+        production) until the consumer releases — no busy polling, no
+        sleeping, the credit queue IS the flow control."""
+        ring = _ring(capacity=2)
+        try:
+            a = ring.acquire(timeout=0)
+            b = ring.acquire(timeout=0)
+            assert {a, b} == {0, 1}
+            assert ring.acquire(timeout=0) is None  # stalled consumer
+            ring.send(("chunk", 0, a))
+            ring.send(("chunk", 1, b))
+            # Consumer pops one and releases it: exactly one credit returns.
+            msg = ring.pop(timeout=0)
+            ring.release(msg[2])
+            assert ring.acquire(timeout=0) == msg[2]
+            assert ring.acquire(timeout=0) is None
+        finally:
+            ring.close()
+
+    def test_wraparound_slot_reuse(self):
+        """7 slabs through a capacity-2 ring: slots recycle; data written
+        in each incarnation reads back intact before release."""
+        ring = _ring(slab_records=4, field_size=2, capacity=2)
+        try:
+            pending = []
+            produced = consumed = 0
+            while consumed < 7:
+                slot = ring.acquire(timeout=0) if produced < 7 else None
+                if slot is not None:
+                    lab, ids, vals = ring.arrays(slot, 3)
+                    lab[:] = produced
+                    ids[:] = produced
+                    vals[:] = produced * 0.5
+                    del lab, ids, vals
+                    ring.send((produced, slot))
+                    produced += 1
+                    continue
+                tag, slot = ring.pop(timeout=0)
+                lab, ids, vals = ring.arrays(slot, 3)
+                assert (lab == tag).all() and (ids == tag).all()
+                assert (vals == tag * 0.5).all()
+                del lab, ids, vals
+                ring.release(slot)
+                pending.append(slot)
+                consumed += 1
+            assert produced == consumed == 7
+            assert set(pending) == {0, 1}  # only two physical slabs existed
+        finally:
+            ring.close()
+
+    def test_out_of_order_release(self):
+        """Free slots are a set, not a cursor: the consumer may hold an
+        early slot (shuffle pool) while later ones recycle repeatedly."""
+        ring = _ring(capacity=3)
+        try:
+            held = ring.acquire(timeout=0)
+            for _ in range(5):  # the other two slots keep cycling
+                s1 = ring.acquire(timeout=0)
+                s2 = ring.acquire(timeout=0)
+                assert held not in (s1, s2)
+                ring.release(s2)
+                ring.release(s1)
+            ring.release(held)
+            got = {ring.acquire(timeout=0) for _ in range(3)}
+            assert got == {0, 1, 2}
+        finally:
+            ring.close()
+
+
+class TestHandleAndLifecycle:
+    def test_handle_attach_shares_memory(self):
+        ring = _ring(slab_records=6, field_size=2)
+        try:
+            other = shm_ring.ShmRing.attach(ring.handle)
+            lab, ids, vals = ring.arrays(1, 6)
+            lab[:] = 3.5
+            del ids, vals
+            lab2, _, _ = other.arrays(1, 6)
+            assert (lab2 == 3.5).all()
+            del lab, lab2
+            other.close()  # non-owner: must not unlink under the owner
+            lab3, _, _ = ring.arrays(1, 6)
+            assert (lab3 == 3.5).all()
+            del lab3
+        finally:
+            ring.close()
+
+    def test_close_is_idempotent_and_survives_live_views(self):
+        ring = _ring()
+        lab, ids, vals = ring.arrays(0, 2)
+        ring.close()  # live exported views: must not raise
+        ring.close()
+        assert lab is not None
+        del lab, ids, vals
+
+
+class TestDecodeIntoSlab:
+    def test_scatter_decode_parity_with_python_codec(self):
+        """A slab is a valid decode_spans_scatter destination: decoding
+        records straight into ring views matches decode_batch_python —
+        the worker's write path against the reference decoder."""
+        from deepfm_tpu.data import example_codec
+        from deepfm_tpu.data import pipeline as pipe_mod
+
+        loader = pipe_mod._native_loader()
+        if loader is None:
+            pytest.skip("native decoder unavailable")
+        F = 5
+        recs = [example_codec.encode_ctr_example(
+            float(i % 2), np.arange(F) + i, np.linspace(0, 1, F) + i)
+            for i in range(7)]
+        ring = _ring(slab_records=8, field_size=F)
+        try:
+            buf = b"".join(recs)
+            lengths = np.array([len(r) for r in recs], np.int64)
+            offsets = np.concatenate(([0], np.cumsum(lengths[:-1])))
+            slot = ring.acquire(timeout=0)
+            labels, ids, vals = ring.arrays(slot, len(recs))
+            loader.decode_spans_scatter(
+                buf, offsets, lengths, F,
+                np.arange(len(recs), dtype=np.int64), labels, ids, vals)
+            ref = pipe_mod.decode_batch_python(recs, F)
+            np.testing.assert_array_equal(labels, ref[0])
+            np.testing.assert_array_equal(ids, ref[1])
+            np.testing.assert_array_equal(vals, ref[2])
+            del labels, ids, vals
+        finally:
+            ring.close()
