@@ -88,6 +88,20 @@ class Config:
     # "input_workers vs reader_threads"). Needs the native decoder; batch
     # order is bit-identical to the in-process path at equal seeds.
     input_workers: int = 0
+    # Decoded-epoch cache (data/cache.py): frame+decode once, serve later
+    # epochs from contiguous column slabs through the same shuffle pool.
+    # "ram" holds the columns in-process; "disk" persists memory-mapped
+    # .npy slabs under decoded_cache_dir (default: <model_dir>/decoded_cache)
+    # keyed by a dataset fingerprint — stale entries rebuild automatically.
+    decoded_cache: str = "off"        # off | ram | disk
+    decoded_cache_dir: str = ""
+    # Device-resident dataset (train/loop.py): when the decoded epoch fits
+    # device_dataset_hbm_fraction of accelerator memory, upload the columns
+    # once and run each epoch as an on-device multi-step program — zero
+    # per-step host->device traffic. Falls back to the staged path with a
+    # RuntimeWarning when over budget or feature-incompatible.
+    device_dataset: bool = False
+    device_dataset_hbm_fraction: float = 0.6
     use_native_decoder: bool = True   # C++ TFRecord decode path
     # CRC32C-check every record. Default False for speed: skipping the
     # check buys ~15-20% host decode throughput on a 1-core host (TUNING.md).
@@ -177,6 +191,17 @@ class Config:
             raise ValueError("io retry backoff/deadline must be >= 0")
         if self.max_save_failures < 0:
             raise ValueError("max_save_failures must be >= 0")
+        if self.decoded_cache not in ("off", "ram", "disk"):
+            raise ValueError(
+                f"decoded_cache must be off|ram|disk, got "
+                f"{self.decoded_cache!r}")
+        if not 0.0 < self.device_dataset_hbm_fraction <= 1.0:
+            raise ValueError(
+                "device_dataset_hbm_fraction must be in (0, 1]")
+        if self.device_dataset and self.decoded_cache == "off":
+            raise ValueError(
+                "device_dataset requires decoded_cache=ram|disk (the device "
+                "upload reads the cached columns)")
 
     # ---- derived views ------------------------------------------------
     @property

@@ -19,6 +19,7 @@ Outputs fixed-shape batches ``{"feat_ids": int32[B,F], "feat_vals": f32[B,F],
 
 from __future__ import annotations
 
+import collections
 import os
 import queue
 import threading
@@ -271,6 +272,8 @@ class CtrPipeline:
         input_workers: int = 0,
         input_worker_slab_records: Optional[int] = None,
         input_worker_death: str = "raise",
+        decoded_cache: str = "off",
+        decoded_cache_dir: str = "",
     ):
         if shard is not None:
             self._files: Tuple[str, ...] = shard.files
@@ -326,6 +329,23 @@ class CtrPipeline:
         self._bad_policy = BadRecordPolicy(
             on_bad_record, max_bad_records, self.health)
         self._retry_policy = retry_policy
+        # Decoded-epoch cache (opt-in, see cache.py): frame+decode once,
+        # serve later epochs from contiguous column slabs through the same
+        # shuffle pool. Disabled under record-sharding — the 1/world filter
+        # keys off the global record index of the per-epoch file order, so
+        # the kept-row set is epoch-dependent and uncacheable.
+        self._on_bad_record = on_bad_record
+        self._max_bad_records = max_bad_records
+        if decoded_cache != "off" and self._record_shard is not None:
+            import warnings  # noqa: PLC0415
+            warnings.warn(
+                "decoded_cache disabled: record-level sharding keeps rows "
+                "by per-epoch global index, which a cache cannot reproduce",
+                RuntimeWarning, stacklevel=2)
+            decoded_cache = "off"
+        self.decoded_cache = decoded_cache
+        self.decoded_cache_dir = decoded_cache_dir
+        self._cache_cols = None  # built/loaded lazily, reused across epochs
 
     # ------------------------------------------------------------------
     # Vectorized fast path (native decode straight to arrays).
@@ -380,6 +400,116 @@ class CtrPipeline:
         if self.shuffle_files:
             np.random.default_rng(self.seed + epoch).shuffle(files)
         return files
+
+    def _epoch_file_order(self, epoch: int) -> List[int]:
+        """Canonical-file INDICES in ``_epoch_files`` order (shuffling a
+        position list consumes the rng identically to shuffling the path
+        list, so both views of the per-epoch order always agree)."""
+        order = list(range(len(self._files)))
+        if self.shuffle_files:
+            np.random.default_rng(self.seed + epoch).shuffle(order)
+        return order
+
+    # ------------------------------------------------------------------
+    # Decoded-epoch cache (tier 1 of the input acceleration layer).
+    # ------------------------------------------------------------------
+    def _make_cache(self):
+        from . import cache as cache_lib  # noqa: PLC0415
+        return cache_lib.DecodedEpochCache(
+            self.decoded_cache, self.decoded_cache_dir, list(self._files),
+            field_size=self.field_size, verify_crc=self.verify_crc,
+            on_bad_record=self._on_bad_record,
+            max_bad_records=self._max_bad_records, health=self.health)
+
+    def _build_cache_columns(self):
+        """One frame+decode pass in CANONICAL file order -> contiguous
+        columns + per-file counts. Reuses the exact framing/CRC/bad-record
+        machinery of the streaming paths, so a cached dataset contains
+        precisely the rows a streamed epoch would have trained on."""
+        from . import cache as cache_lib  # noqa: PLC0415
+        loader = _native_loader() if self._use_native else None
+        counts = np.zeros(len(self._files), np.int64)
+        labs: List[np.ndarray] = []
+        idss: List[np.ndarray] = []
+        valss: List[np.ndarray] = []
+        for fi, path in enumerate(self._files):
+            n_file = 0
+            if loader is not None:
+                for buf, offsets, lengths in _iter_framed_chunks(
+                        path, loader, self.verify_crc,
+                        policy=self._bad_policy,
+                        retry_policy=self._retry_policy):
+                    if len(offsets) == 0:
+                        continue
+                    lab, ids, vals = loader.decode_spans(
+                        buf, offsets, lengths, self.field_size)
+                    labs.append(lab)
+                    idss.append(ids)
+                    valss.append(vals)
+                    n_file += len(lab)
+            else:
+                recs = list(_iter_file_records(
+                    path, False, self.verify_crc, policy=self._bad_policy,
+                    retry_policy=self._retry_policy))
+                if recs:
+                    lab, ids, vals = self._decode(recs, self.field_size)
+                    labs.append(lab)
+                    idss.append(ids.astype(np.int32, copy=False))
+                    valss.append(vals)
+                    n_file += len(lab)
+            counts[fi] = n_file
+        if counts.sum() == 0 and len(self._files):
+            raise IOError(f"no records found in {len(self._files)} files")
+        return cache_lib.CacheColumns(
+            np.concatenate(labs).astype(np.float32, copy=False),
+            np.concatenate(idss),
+            np.concatenate(valss),
+            counts)
+
+    def decoded_epoch_columns(self):
+        """The dataset as cached columns, building the cache on miss (also
+        the upload source for the device-resident fit path). Raises if the
+        cache is off."""
+        if self.decoded_cache == "off":
+            raise RuntimeError("decoded_epoch_columns requires decoded_cache")
+        if self._cache_cols is None:
+            self._cache_cols = self._make_cache().get_or_build(
+                self._build_cache_columns)
+        return self._cache_cols
+
+    def decoded_cache_fingerprint(self) -> str:
+        """Identity of the cached columns (device-upload cache key)."""
+        return self._make_cache().fingerprint
+
+    def device_epoch_indices(self, epoch: int, k: int = 1) -> np.ndarray:
+        """Row indices into the cached columns in EXACTLY the order the
+        staged pooled path would emit them this epoch — the tiny per-epoch
+        upload of the device-resident fit (4 bytes/record vs re-sending
+        every row).
+
+        Valid only in the single-drain regime (the pool covers the whole
+        epoch: ``n < max(shuffle_buffer, k*batch_size)``), where the final
+        drain scatters arrival row j to position perm[j] of one full
+        permutation, so the emitted sequence is ``arrival[argsort(perm)]``.
+        With a smaller pool the drain points depend on chunk boundaries and
+        the caller must keep the staged path instead."""
+        cols = self.decoded_epoch_columns()
+        starts = np.zeros(len(cols.counts) + 1, np.int64)
+        np.cumsum(cols.counts, out=starts[1:])
+        arrival = np.concatenate([
+            np.arange(starts[fi], starts[fi + 1], dtype=np.int64)
+            for fi in self._epoch_file_order(epoch)]) if len(cols.counts) \
+            else np.zeros((0,), np.int64)
+        if not self.shuffle:
+            return arrival.astype(np.int32)
+        n = len(arrival)
+        if n >= max(self.shuffle_buffer, k * self.batch_size):
+            raise ValueError(
+                "device_epoch_indices requires the shuffle pool to cover "
+                f"the epoch (n={n} >= pool target); use the staged path")
+        perm = np.random.default_rng(
+            self.seed * 1_000_003 + epoch).permutation(n)
+        return arrival[np.argsort(perm)].astype(np.int32)
 
     def _make_input_service(self, epoch: int):
         """Spawn the decode-worker fleet for one epoch, or None to fall
@@ -507,8 +637,14 @@ class CtrPipeline:
         # bit-identical batches — the parity the bench asserts. Disabled
         # under record-sharding (workers see per-file streams, not the
         # global record index the 1/world filter needs).
-        use_shm = (self.input_workers > 0 and loader is not None
-                   and self._record_shard is None)
+        # Cached columns trump every decode path: no framing, no decode,
+        # no worker fleet — chunks are zero-copy views into the slab.
+        cached_cols = None
+        if self.decoded_cache != "off":
+            from . import cache as cache_lib  # noqa: PLC0415
+            cached_cols = self.decoded_epoch_columns()
+        use_shm = (cached_cols is None and self.input_workers > 0
+                   and loader is not None and self._record_shard is None)
         # Fused scatter-decode (r5): with shuffle on and the native decoder
         # available, the proto decode is DEFERRED to drain time and each
         # record decodes straight into its permuted pool row — one pass per
@@ -535,7 +671,7 @@ class CtrPipeline:
                 rng = np.random.default_rng(self.seed * 1_000_003 + epoch)
                 pool_target = (max(self.shuffle_buffer, sb)
                                if self.shuffle else sb)
-                pend: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+                pend: "collections.deque" = collections.deque()
                 raw: List[Tuple[bytes, np.ndarray, np.ndarray]] = []
                 n_pend = 0
                 service = self._make_input_service(epoch) if use_shm else None
@@ -567,7 +703,7 @@ class CtrPipeline:
                             self._scatter_decode_raw(
                                 loader, raw, perm, off, labels, ids, vals,
                                 drain_pool)
-                        pend = [(labels, ids, vals)]
+                        pend = collections.deque([(labels, ids, vals)])
                         raw = []
                         if service is not None:
                             # Every held slab view has been scattered into
@@ -585,7 +721,15 @@ class CtrPipeline:
                             yield self._assemble_batch(pend, n_pend), 1, n_pend
                             n_pend = 0
 
-                if service is not None:
+                if cached_cols is not None:
+                    for chunk in cache_lib.epoch_chunks(
+                            cached_cols, self._epoch_file_order(epoch)):
+                        pend.append(chunk)
+                        n_pend += len(chunk[0])
+                        if n_pend >= pool_target:
+                            yield from drain(final=False)
+                    yield from drain(final=True)
+                elif service is not None:
                     with service:
                         # shuffle=False never scatters, so views would stay
                         # referenced by batch slices indefinitely: copy out
@@ -630,7 +774,7 @@ class CtrPipeline:
         (the e2e bottleneck on small hosts; VERDICT r2 #5).
         """
         loader = _native_loader() if self._use_native else None
-        if loader is None or k <= 1:
+        if (loader is None and self.decoded_cache == "off") or k <= 1:
             # Per-record path: group plain batches (stack copy at transfer;
             # skip/prefetch handled by __iter__).
             yield from _group_plain_batches(iter(self), k, self.batch_size)
@@ -645,15 +789,17 @@ class CtrPipeline:
         yield from src
 
     @staticmethod
-    def _assemble_batch(pend: List[Tuple[np.ndarray, np.ndarray, np.ndarray]],
+    def _assemble_batch(pend: "collections.deque",
                         bs: int) -> Batch:
-        """Pop exactly ``bs`` rows off the front of the pending chunk list."""
+        """Pop exactly ``bs`` rows off the front of the pending chunk
+        deque (O(1) per chunk; a list's pop(0) re-shifts the whole pool
+        every batch)."""
         take: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
         need = bs
         while need:
             labels, ids, vals = pend[0]
             if len(labels) <= need:
-                take.append(pend.pop(0))
+                take.append(pend.popleft())
                 need -= len(labels)
             else:
                 take.append((labels[:need], ids[:need], vals[:need]))
@@ -742,7 +888,9 @@ class CtrPipeline:
         chunks (typically >> the 10k-record buffer of the record path),
         plus the per-epoch file-order shuffle."""
         loader = _native_loader() if self._use_native else None
-        if loader is not None:
+        if loader is not None or self.decoded_cache != "off":
+            # Cached columns need no decoder, so the pooled path also
+            # serves toolchain-less hosts once the cache is warm.
             return self._iter_batches_vectorized(loader)
         return self._iter_batches_sync()
 
@@ -897,7 +1045,7 @@ class StreamingCtrPipeline:
         of k (only the grouping differs)."""
         bs = self.batch_size
         sb = bs * max(k, 1)
-        pend: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+        pend: "collections.deque" = collections.deque()
         n_pend = 0
         n_seen = 0
         for buf, offsets, lengths in _iter_framed_stream(

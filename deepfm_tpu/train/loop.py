@@ -99,6 +99,11 @@ class Trainer:
         self._eval_multi_step: Optional[Callable] = None
         self._predict_step: Optional[Callable] = None
         self._predict_multi_step: Optional[Callable] = None
+        # Device-resident dataset mode: uploaded columns keyed by the
+        # decoded-cache fingerprint, and one compiled program per
+        # (steps, batch) shape.
+        self._dd_cols: Optional[Tuple[str, Dict[str, jax.Array]]] = None
+        self._dd_programs: Dict[Tuple[int, int], Callable] = {}
 
     # ------------------------------------------------------------------
     # State creation / placement
@@ -718,6 +723,226 @@ class Trainer:
             # Fold the async-dispatch drain into the measurement window so
             # the meter reports completed-on-device throughput, not host
             # dispatch rate.
+            jax.block_until_ready(m["loss"])
+            meter.record_drain()
+        if np.isnan(last_loss) and n_steps:
+            last_loss = float(m["loss"])
+        out = {"loss": last_loss, "steps": float(n_steps)}
+        out.update({k_: v for k_, v in meter.summary().items() if k_ != "steps"})
+        return state, out
+
+    # ------------------------------------------------------------------
+    # Device-resident dataset mode
+    # ------------------------------------------------------------------
+    # The decoded epoch lives in device memory; each dispatch gathers its
+    # batches by row index ON DEVICE, so per-dispatch host->device traffic
+    # is one int32 scalar (the cursor) instead of k*B records. The epoch's
+    # emission order is computed on host exactly as the staged pooled path
+    # would emit it, so with mesh=None the trajectory is bit-identical to
+    # ``fit`` over the same pipeline (the CPU parity test pins this).
+
+    @staticmethod
+    def _device_memory_bytes() -> int:
+        """Per-device memory limit, or a 16 GiB assumption where the
+        backend doesn't report one (CPU): the budget check then still
+        exercises deterministically via device_dataset_hbm_fraction."""
+        try:
+            stats = jax.devices()[0].memory_stats() or {}
+            limit = int(stats.get("bytes_limit", 0))
+            if limit > 0:
+                return limit
+        except Exception:
+            pass
+        return 16 << 30
+
+    def device_dataset_ineligible(self, pipe) -> Optional[str]:
+        """None when ``fit_device_resident`` can reproduce the staged run
+        for this pipeline, else a human-readable disqualifier (the caller
+        warns and falls back to the staged path)."""
+        cfg = self.cfg
+        if jax.process_count() > 1:
+            return "multi-process run (device columns would need per-host "\
+                   "record sharding)"
+        if self.mesh_info.model_size > 1:
+            return "model-parallel mesh (row-sharded embedding lookups use "\
+                   "the shard_map step path)"
+        if getattr(pipe, "decoded_cache", "off") == "off":
+            return "pipeline has no decoded cache (device upload reads the "\
+                   "cached columns)"
+        if getattr(pipe, "skip_batches", 0):
+            return "resume skip_batches offset pending (staged path owns "\
+                   "the trained-prefix drop)"
+        try:
+            cols = pipe.decoded_epoch_columns()
+        except Exception as exc:  # cache build failed: surface via staged path
+            return f"decoded cache unavailable ({exc})"
+        n = cols.num_records
+        if n == 0:
+            return "empty dataset"
+        k = max(cfg.steps_per_loop, 1)
+        if pipe.shuffle and n >= max(pipe.shuffle_buffer, k * pipe.batch_size):
+            return (f"shuffle pool smaller than the epoch ({n} records): "
+                    "pool drain order depends on chunk arrival and cannot "
+                    "be reproduced as a device gather")
+        per_device = (cols.nbytes() // max(self.mesh_info.data_size, 1)
+                      + n * 4)  # columns (row-sharded) + replicated index
+        budget = int(self._device_memory_bytes()
+                     * cfg.device_dataset_hbm_fraction)
+        if per_device > budget:
+            return (f"decoded epoch needs ~{per_device / 2**20:.1f} MiB "
+                    f"per device, over the {budget / 2**20:.1f} MiB budget "
+                    f"(device_dataset_hbm_fraction="
+                    f"{cfg.device_dataset_hbm_fraction})")
+        return None
+
+    def _dd_upload(self, pipe) -> Dict[str, jax.Array]:
+        """Upload the cached columns once per fingerprint; later epochs
+        (and later fit calls over the same data) reuse the device copy."""
+        fp = pipe.decoded_cache_fingerprint()
+        if self._dd_cols is not None and self._dd_cols[0] == fp:
+            return self._dd_cols[1]
+        cols = pipe.decoded_epoch_columns()
+        host = {"label": np.ascontiguousarray(cols.labels, np.float32),
+                "feat_ids": np.ascontiguousarray(cols.ids, np.int32),
+                "feat_vals": np.ascontiguousarray(cols.vals, np.float32)}
+        mi = self.mesh_info
+        if mi.mesh is None:
+            dev = jax.device_put(host)
+        else:
+            # Single-process data mesh: rows sharded over 'data' (padding
+            # rows are never indexed — every gather index is < n).
+            pad = (-cols.num_records) % mi.data_size
+            if pad:
+                host = {key: np.concatenate(
+                    [v, np.zeros((pad,) + v.shape[1:], v.dtype)])
+                    for key, v in host.items()}
+            dev = {key: jax.device_put(v, mi.sharding(
+                P(mesh_lib.DATA_AXIS, *([None] * (v.ndim - 1)))))
+                for key, v in host.items()}
+        self._dd_cols = (fp, dev)
+        return dev
+
+    def _dd_put_indices(self, idx: np.ndarray) -> jax.Array:
+        mi = self.mesh_info
+        if mi.mesh is None:
+            return jax.device_put(idx)
+        return jax.device_put(idx, mi.sharding(P(None)))
+
+    def _dd_program(self, m_steps: int, bsz: int) -> Callable:
+        """Compiled ``(state, cols, idx, start) -> (state, metrics)``: slice
+        ``m_steps*bsz`` emission indices at the cursor, gather the rows on
+        device, scan the train step over them (same rng folding and metric
+        convention as ``multi_step``). ``start`` is a traced scalar, so one
+        compile serves every cursor position of this shape."""
+        key = (m_steps, bsz)
+        prog = self._dd_programs.get(key)
+        if prog is not None:
+            return prog
+
+        def run(state: TrainState, cols, idx, start):
+            sel = jax.lax.dynamic_slice_in_dim(idx, start, m_steps * bsz)
+            sel = sel.reshape(m_steps, bsz)
+
+            def body(st, s):
+                batch = {"label": cols["label"][s],
+                         "feat_ids": cols["feat_ids"][s],
+                         "feat_vals": cols["feat_vals"][s]}
+                new_st, m = self._step_impl(
+                    st, batch, data_axis=None, shard_axis=None)
+                return new_st, jnp.stack((m["loss"], m["xent"]))
+
+            state2, ms = jax.lax.scan(body, state, sel)
+            return state2, {"loss": ms[-1, 0], "xent": ms[-1, 1]}
+
+        # Plain jit even under a (pure-data) mesh: inputs carry their
+        # shardings and GSPMD partitions the gather + step; the global-mean
+        # gradient math is identical to the single-device formulation.
+        prog = jax.jit(run, donate_argnums=0)
+        self._dd_programs[key] = prog
+        return prog
+
+    def fit_device_resident(
+        self,
+        state: TrainState,
+        pipe,
+        *,
+        hooks: Optional[list] = None,
+        max_steps: Optional[int] = None,
+        on_log: Optional[Callable[[int, float, float], None]] = None,
+    ) -> Tuple[TrainState, Dict[str, float]]:
+        """Train with the whole decoded dataset resident on device.
+
+        Callers must have cleared :meth:`device_dataset_ineligible` first.
+        Mirrors ``fit``'s contract: same dispatch grouping as the staged
+        pooled pipeline (k-step superbatches, then single batches, then the
+        short remainder unless ``drop_remainder``), same hook/log/meter
+        cadence, same return dict.
+        """
+        cfg = self.cfg
+        k = max(cfg.steps_per_loop, 1)
+        bs = pipe.batch_size
+        cols = pipe.decoded_epoch_columns()
+        n = cols.num_records
+        dev_cols = self._dd_upload(pipe)
+        remaining = max_steps
+        meter = prof_lib.ThroughputMeter()
+        last_loss = float("nan")
+        t0 = time.time()
+        examples_since_log = 0
+        n_steps = 0
+        m: Dict[str, Any] = {}
+        health = getattr(pipe, "health", None)
+        for e in range(pipe.num_epochs):
+            if remaining is not None and remaining <= 0:
+                break
+            epoch = e + getattr(pipe, "epoch_offset", 0)
+            idx_dev = self._dd_put_indices(pipe.device_epoch_indices(epoch, k))
+            # The staged pool's emission plan for one epoch, as batch sizes.
+            n_batches = n // bs
+            r = n - n_batches * bs
+            sizes = [bs] * n_batches
+            if r and not pipe.drop_remainder:
+                sizes.append(r)
+            if remaining is not None:
+                sizes = sizes[:remaining]
+                remaining -= len(sizes)
+            start = 0
+            i = 0
+            while i < len(sizes):
+                if (sizes[i] == bs and i + k <= len(sizes)
+                        and sizes[i + k - 1] == bs):
+                    mm, bsz = k, bs
+                else:
+                    mm, bsz = 1, sizes[i]
+                prog = self._dd_program(mm, bsz)
+                state, m = prog(state, dev_cols, idx_dev, np.int32(start))
+                start += mm * bsz
+                i += mm
+                prev_steps = n_steps
+                n_steps += mm
+                examples_since_log += mm * bsz
+                meter.update(mm * bsz, mm)
+                if cfg.log_steps and (n_steps // cfg.log_steps
+                                      > prev_steps // cfg.log_steps):
+                    loss = float(m["loss"])
+                    gstep = int(state.step)
+                    last_loss = loss
+                    dt = time.time() - t0
+                    eps = examples_since_log / max(dt, 1e-9)
+                    ulog.info(f"step={gstep} loss={loss:.5f} "
+                              f"examples/sec={eps:,.0f}")
+                    if health is not None and health.consume_dirty():
+                        ulog.info(f"data health: {health.summary()}")
+                    if on_log is not None:
+                        on_log(gstep, loss, eps)
+                    t0 = time.time()
+                    examples_since_log = 0
+                if hooks:
+                    m = dict(m)
+                    m["steps_done"] = mm
+                    for hook in hooks:
+                        hook(state, m)
+        if n_steps:
             jax.block_until_ready(m["loss"])
             meter.record_drain()
         if np.isnan(last_loss) and n_steps:

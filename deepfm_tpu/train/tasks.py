@@ -21,12 +21,14 @@ from __future__ import annotations
 
 import glob as _glob
 import os
+import warnings
 from typing import Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 from ..config import Config
+from ..data import cache as cache_lib
 from ..data import fileio
 from ..data import pipeline as pipe_lib
 from ..data import sharding as shard_lib
@@ -157,6 +159,19 @@ def _fault_tolerance_kwargs(cfg: Config) -> Dict:
     )
 
 
+def _decoded_cache_dir(cfg: Config) -> str:
+    """Disk-cache location: explicit flag, else a model_dir subdirectory
+    (keeps the slabs next to the artifacts they trained)."""
+    if cfg.decoded_cache != "disk":
+        return ""
+    if cfg.decoded_cache_dir:
+        return cfg.decoded_cache_dir
+    if cfg.model_dir:
+        return os.path.join(cfg.model_dir, "decoded_cache")
+    raise ValueError("--decoded_cache disk needs --decoded_cache_dir "
+                     "or --model_dir")
+
+
 def make_pipeline(cfg: Config, files: List[str], *, epochs: int = 1,
                   shuffle: bool = True, sharded: bool = True,
                   drop_remainder: Optional[bool] = None,
@@ -164,6 +179,8 @@ def make_pipeline(cfg: Config, files: List[str], *, epochs: int = 1,
                   skip_batches: int = 0) -> pipe_lib.CtrPipeline:
     return pipe_lib.CtrPipeline(
         files,
+        decoded_cache=cfg.decoded_cache,
+        decoded_cache_dir=_decoded_cache_dir(cfg),
         epoch_offset=epoch_offset,
         skip_batches=skip_batches,
         field_size=cfg.field_size,
@@ -223,6 +240,23 @@ def make_streaming_pipeline(cfg: Config, files: List[str], *, epochs: int = 1,
         max_bad_records=cfg.max_bad_records,
         health=health,
     )
+
+
+def _fit_epoch(trainer: Trainer, cfg: Config, state: TrainState, pipeline,
+               hooks, on_log) -> Tuple[TrainState, Dict[str, float]]:
+    """One epoch of training: device-resident when ``--device_dataset`` is
+    set and the run qualifies, otherwise the staged host pipeline. The
+    fallback warns with the disqualifier so an operator expecting device
+    residency learns why the run is staged."""
+    if cfg.device_dataset:
+        reason = trainer.device_dataset_ineligible(pipeline)
+        if reason is None:
+            return trainer.fit_device_resident(
+                state, pipeline, hooks=hooks, on_log=on_log)
+        warnings.warn(
+            f"--device_dataset fell back to the staged input path: {reason}",
+            RuntimeWarning, stacklevel=2)
+    return trainer.fit(state, pipeline, hooks=hooks, on_log=on_log)
 
 
 def _restore_or_init(trainer: Trainer, cfg: Config, require: bool,
@@ -430,10 +464,13 @@ def _consumption_layout(cfg: Config) -> List[int]:
     # emission order for identical config changes (e.g. the r3 scatter
     # permutation), so a resume across framework versions falls back to
     # epoch-replay instead of silently mis-skipping.
+    # decoded_cache changes chunk-arrival boundaries and therefore the pool
+    # drain points whenever the pool is smaller than the epoch, so a resume
+    # across cache modes must fall back to epoch-replay.
     return [2, jax.process_count(), cfg.steps_per_loop,
             int(cfg.use_native_decoder), cfg.batch_size,
             cfg.shuffle_buffer, cfg.seed, int(cfg.drop_remainder),
-            int(cfg.shuffle_files)]
+            int(cfg.shuffle_files), cache_lib.MODES.index(cfg.decoded_cache)]
 
 
 def _resume_position(cfg: Config, restored_step: int,
@@ -706,8 +743,8 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                         epoch_offset=epoch_base + epoch,
                         skip_batches=(skip_batches if epoch == start_epoch
                                       else 0))
-                    state, fit_m = trainer.fit(state, pipeline, hooks=hooks,
-                                               on_log=_tb_log)
+                    state, fit_m = _fit_epoch(trainer, cfg, state, pipeline,
+                                              hooks, _tb_log)
                     _log_health(pipeline, f"epoch {epoch + 1} end")
                     if fit_m["steps"]:
                         # (a fully-skipped resumed epoch reports no loss)

@@ -839,3 +839,60 @@ class TestPooledEmissionGolden:
             h.update(rows["feat_vals"].tobytes())
             h.update(rows["label"].tobytes())
         assert h.hexdigest()[:24] == self.GOLDEN[(8, 64, 0, True)]
+
+
+class TestAssembleBatchDeque:
+    """_assemble_batch runs on a deque (O(1) front pops); emission must be
+    identical to the original list-shifting implementation."""
+
+    @staticmethod
+    def _reference(pend, bs):
+        # The pre-deque list implementation, verbatim.
+        take = []
+        need = bs
+        while need:
+            labels, ids, vals = pend[0]
+            if len(labels) <= need:
+                take.append(pend.pop(0))
+                need -= len(labels)
+            else:
+                take.append((labels[:need], ids[:need], vals[:need]))
+                pend[0] = (labels[need:], ids[need:], vals[need:])
+                need = 0
+        labels = np.concatenate([t[0] for t in take])
+        ids = np.concatenate([t[1] for t in take])
+        vals = np.concatenate([t[2] for t in take])
+        return {
+            "feat_ids": np.ascontiguousarray(ids, np.int32),
+            "feat_vals": np.ascontiguousarray(vals, np.float32),
+            "label": labels.reshape(-1, 1).astype(np.float32),
+        }
+
+    def test_matches_list_reference(self):
+        import collections
+        rng = np.random.default_rng(3)
+        chunks = []
+        for _ in range(40):
+            n = int(rng.integers(1, 97))
+            chunks.append((
+                rng.random(n).astype(np.float32),
+                rng.integers(0, 1000, (n, 7)).astype(np.int32),
+                rng.random((n, 7)).astype(np.float32)))
+        total = sum(len(c[0]) for c in chunks)
+        dq = collections.deque(chunks)
+        ref = [tuple(c) for c in chunks]
+        bs = 64
+        emitted = 0
+        while total - emitted >= bs:
+            got = pipeline.CtrPipeline._assemble_batch(dq, bs)
+            want = self._reference(ref, bs)
+            for k in ("label", "feat_ids", "feat_vals"):
+                np.testing.assert_array_equal(got[k], want[k])
+            emitted += bs
+        tail = total - emitted
+        if tail:
+            got = pipeline.CtrPipeline._assemble_batch(dq, tail)
+            want = self._reference(ref, tail)
+            for k in ("label", "feat_ids", "feat_vals"):
+                np.testing.assert_array_equal(got[k], want[k])
+        assert not dq and not ref

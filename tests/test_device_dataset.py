@@ -1,0 +1,123 @@
+"""Device-resident dataset mode tests: CPU bit-parity against the staged
+fit over identical pipelines, eligibility gating, and the over-budget
+RuntimeWarning fallback. Single-device (``mesh_data=1``) — staged-vs-device
+parity under a mesh inherits the environment's XLA CPU numerics drift."""
+
+import jax.tree_util as jtu
+import numpy as np
+import pytest
+
+from deepfm_tpu.config import Config
+from deepfm_tpu.data import cache as cache_lib
+from deepfm_tpu.data import libsvm
+from deepfm_tpu.train import tasks as tasks_lib
+from deepfm_tpu.train.loop import Trainer
+
+pytestmark = pytest.mark.device_dataset
+
+FIELD = 6
+FEATURES = 250
+
+
+@pytest.fixture()
+def dataset(tmp_path):
+    data = tmp_path / "data"
+    libsvm.generate_synthetic_ctr(
+        str(data), num_files=2, examples_per_file=80, field_size=FIELD,
+        feature_size=FEATURES, seed=4, prefix="tr")
+    return sorted(str(p) for p in data.glob("tr*.tfrecords"))
+
+
+def _cfg(**over):
+    kw = dict(feature_size=FEATURES, field_size=FIELD, embedding_size=8,
+              deep_layers="16,8", dropout="1.0,1.0", batch_size=16,
+              steps_per_loop=4, num_epochs=2, shuffle_buffer=1 << 20,
+              learning_rate=0.01, log_steps=0, seed=21, mesh_data=1,
+              decoded_cache="ram")
+    kw.update(over)
+    return Config(**kw)
+
+
+def _train(cfg, files, max_steps=None):
+    """The task driver's per-epoch loop: one pipeline + one fit per epoch,
+    routed through the same device/staged dispatcher the train task uses."""
+    cache_lib.clear_ram_cache()
+    trainer = Trainer(cfg)
+    state = trainer.init_state()
+    losses = []
+    hooks = [lambda s, m: losses.append(
+        (float(m["loss"]), int(m.get("steps_done", 0))))]
+    for epoch in range(cfg.num_epochs):
+        pipe = tasks_lib.make_pipeline(cfg, files, epochs=1, shuffle=True,
+                                       epoch_offset=epoch)
+        if max_steps is not None:
+            if cfg.device_dataset:
+                state, fit_m = trainer.fit_device_resident(
+                    state, pipe, hooks=hooks, max_steps=max_steps)
+            else:
+                state, fit_m = trainer.fit(
+                    state, pipe, hooks=hooks, max_steps=max_steps)
+        else:
+            state, fit_m = tasks_lib._fit_epoch(
+                trainer, cfg, state, pipe, hooks, None)
+    return state, losses, fit_m
+
+
+class TestDeviceResidentParity:
+    def test_matches_staged_bitwise(self, dataset):
+        """Same seed => same per-dispatch loss sequence AND bit-identical
+        final params: the device gather replays the staged pool's emission
+        order exactly (single-drain regime), and rng folds in state.step,
+        so dispatch mechanics cannot alter the trajectory."""
+        s_staged, l_staged, _ = _train(_cfg(device_dataset=False), dataset)
+        s_dev, l_dev, fit_m = _train(_cfg(device_dataset=True), dataset)
+        assert l_staged == l_dev
+        assert int(s_staged.step) == int(s_dev.step)
+        for a, b in zip(jtu.tree_leaves(s_staged.params),
+                        jtu.tree_leaves(s_dev.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert fit_m["steps"] * 2 == int(s_dev.step)  # equal epochs
+
+    def test_max_steps_truncation_matches(self, dataset):
+        cfg_s = _cfg(device_dataset=False, num_epochs=1)
+        cfg_d = _cfg(device_dataset=True, num_epochs=1)
+        _, l_staged, m_staged = _train(cfg_s, dataset, max_steps=7)
+        _, l_dev, m_dev = _train(cfg_d, dataset, max_steps=7)
+        assert m_staged["steps"] == m_dev["steps"] == 7.0
+        assert l_staged == l_dev
+
+
+class TestDeviceDatasetFallback:
+    def test_over_budget_falls_back_with_warning(self, dataset):
+        cfg = _cfg(device_dataset=True, device_dataset_hbm_fraction=1e-12,
+                   num_epochs=1)
+        cache_lib.clear_ram_cache()
+        trainer = Trainer(cfg)
+        state = trainer.init_state()
+        pipe = tasks_lib.make_pipeline(cfg, dataset, epochs=1, shuffle=True)
+        with pytest.warns(RuntimeWarning, match="fell back to the staged"):
+            state, fit_m = tasks_lib._fit_epoch(
+                trainer, cfg, state, pipe, [], None)
+        assert fit_m["steps"] > 0  # training still happened, staged
+
+    def test_ineligible_reasons(self, dataset):
+        trainer = Trainer(_cfg(device_dataset=True))
+        # No decoded cache on the pipeline.
+        cfg_off = _cfg(decoded_cache="off")
+        pipe = tasks_lib.make_pipeline(cfg_off, dataset, epochs=1)
+        assert "no decoded cache" in trainer.device_dataset_ineligible(pipe)
+        # Pool smaller than the epoch: drain boundaries are arrival-
+        # dependent, not reproducible as a device gather.
+        cfg_small = _cfg(shuffle_buffer=32)
+        pipe = tasks_lib.make_pipeline(cfg_small, dataset, epochs=1)
+        assert "pool smaller" in trainer.device_dataset_ineligible(pipe)
+        # Mid-epoch resume prefix: owned by the staged skip machinery.
+        pipe = tasks_lib.make_pipeline(_cfg(), dataset, epochs=1,
+                                       skip_batches=3)
+        assert "skip_batches" in trainer.device_dataset_ineligible(pipe)
+
+    def test_eligible_pipeline_reports_none(self, dataset):
+        cache_lib.clear_ram_cache()
+        trainer = Trainer(_cfg(device_dataset=True))
+        pipe = tasks_lib.make_pipeline(_cfg(), dataset, epochs=1)
+        assert trainer.device_dataset_ineligible(pipe) is None

@@ -17,6 +17,10 @@ import pytest
 
 from deepfm_tpu.data import libsvm
 
+# Every test here spawns a real 2-process jax.distributed cluster on the CPU
+# backend; gated on the conftest cross-process-collectives probe.
+pytestmark = pytest.mark.mp_collectives
+
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _RUNNER = """

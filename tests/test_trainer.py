@@ -95,6 +95,7 @@ class TestDistributedParity:
         ev = tr.evaluate(state, _pipeline(cfg, files, shuffle=False))
         return tr, state, ev
 
+    @pytest.mark.mesh_bitexact
     def test_dp8_matches_single(self, data_files):
         _, s1, ev1 = self._run(_cfg(), data_files)
         _, s8, ev8 = self._run(_cfg(mesh_data=8), data_files)
@@ -107,6 +108,7 @@ class TestDistributedParity:
         assert abs(ev1["auc"] - ev8["auc"]) < 5e-3
         assert abs(ev1["loss"] - ev8["loss"]) < 1e-4
 
+    @pytest.mark.mesh_bitexact
     def test_dp4_x_rowshard2_matches_single(self, data_files):
         _, s1, ev1 = self._run(_cfg(), data_files)
         cfg = _cfg(mesh_data=4, mesh_model=2, feature_size=500)
@@ -121,6 +123,7 @@ class TestDistributedParity:
         assert pad.shape[0] == tr.model.padded_vocab - 500
         assert (pad == 0).all()
 
+    @pytest.mark.mesh_bitexact
     def test_rowshard_only_mesh(self, data_files):
         """model-axis-only mesh (1x8): pure embedding sharding."""
         cfg = _cfg(mesh_data=1, mesh_model=8)
@@ -141,6 +144,7 @@ class TestDistributedParity:
         shard_shapes = {tuple(s.data.shape) for s in state.params["fm_v"].addressable_shards}
         assert shard_shapes == {(tr.model.padded_vocab // 2, 8)}
 
+    @pytest.mark.mesh_bitexact
     def test_allgather_lookup_matches_masked_psum(self, data_files):
         """Both sharded-lookup strategies train to the same weights (the
         collective pattern is an implementation detail of the same gather);
@@ -155,6 +159,7 @@ class TestDistributedParity:
             rtol=1e-4, atol=1e-6)
         assert abs(ev_psum["loss"] - ev_ag["loss"]) < 1e-5
 
+    @pytest.mark.mesh_bitexact
     def test_bn_cross_replica_parity(self, data_files):
         cfg1 = _cfg(batch_norm=True)
         cfg8 = _cfg(batch_norm=True, mesh_data=8)
@@ -172,6 +177,7 @@ class TestDistributedParity:
         assert np.isfinite(ev["loss"])
         assert 0.0 <= ev["auc"] <= 1.0
 
+    @pytest.mark.mesh_bitexact
     def test_checkpoint_portable_across_meshes(self, data_files, tmp_path):
         """A checkpoint trained row-sharded restores on a DIFFERENT mesh
         (resize after preemption, single-chip eval of a pod-trained model).
@@ -199,6 +205,7 @@ class TestDistributedParity:
             assert ev2["auc"] == pytest.approx(ev42["auc"], abs=1e-5), mesh_kw
             assert ev2["loss"] == pytest.approx(ev42["loss"], abs=1e-5), mesh_kw
 
+    @pytest.mark.mesh_bitexact
     @pytest.mark.parametrize("opt", ["Adagrad", "Momentum", "ftrl"])
     def test_optimizer_zoo_distributed_parity(self, data_files, opt):
         _, s1, ev1 = self._run(_cfg(optimizer=opt), data_files, steps=6)
@@ -224,7 +231,8 @@ class TestStepsPerLoop:
             state, _pipeline(cfg, files, shuffle=False), max_steps=n_batches)
         return state, summary
 
-    @pytest.mark.parametrize("mesh", [False, True])
+    @pytest.mark.parametrize(
+        "mesh", [False, pytest.param(True, marks=pytest.mark.mesh_bitexact)])
     def test_k4_matches_k1(self, data_files, mesh):
         # 11 batches: 2 full scan groups of 4 + 3 tail single steps.
         s1, sum1 = self._run_k(1, data_files, mesh)
@@ -271,7 +279,8 @@ class TestScannedEvalPredict:
                           max_steps=4)
         return cfg, tr, state
 
-    @pytest.mark.parametrize("mesh", [False, True])
+    @pytest.mark.parametrize(
+        "mesh", [False, pytest.param(True, marks=pytest.mark.mesh_bitexact)])
     def test_eval_k4_matches_k1(self, data_files, mesh):
         # 11 batches per variant: 2 full scan groups of 4 + 3 tail singles
         # on the k=4 side (plus a ragged final pipeline batch exercising the
@@ -284,7 +293,8 @@ class TestScannedEvalPredict:
         assert ev1["auc"] == ev4["auc"]          # bit-identical, not approx
         assert ev1["loss"] == ev4["loss"]
 
-    @pytest.mark.parametrize("mesh", [False, True])
+    @pytest.mark.parametrize(
+        "mesh", [False, pytest.param(True, marks=pytest.mark.mesh_bitexact)])
     def test_predict_k4_matches_k1(self, data_files, mesh):
         from deepfm_tpu.train.loop import pad_batch
         _, tr1, st1 = self._trained(data_files, 1, mesh)
