@@ -118,6 +118,19 @@ class Config:
     io_retries: int = 4               # attempts per I/O op (1 = no retry)
     io_retry_backoff_secs: float = 0.1  # base of exponential full-jitter backoff
     io_retry_deadline_secs: float = 0.0  # per-op wall-clock cap (0 = none)
+    # ---- training-runtime resilience (see README "Preemption & self-healing") ----
+    # Policy for a non-finite loss / non-finite params after a dispatch:
+    # abort raises (checked at log cadence — free); skip drops the poisoned
+    # dispatch's update; rollback restores the last checkpoint and replays
+    # from its recorded offset. skip/rollback sync the loss every dispatch.
+    on_nonfinite: str = "abort"       # abort | skip | rollback
+    max_rollbacks: int = 3            # shared skip+rollback budget per run
+    # Abort (exit code 43) when no dispatch completes within this many
+    # seconds; also bounds input-worker ring reads. 0 disables.
+    dispatch_timeout_s: float = 0.0
+    # Warn + count when |loss - EMA| exceeds this many EMA std-devs
+    # (after warmup). Advisory only; 0 disables.
+    loss_spike_zscore: float = 0.0
 
     # ---- mesh / parallelism (replaces TF_CONFIG + horovod knobs) ----
     mesh_data: int = 0                # data-parallel axis size (0 = all devices)
@@ -191,6 +204,16 @@ class Config:
             raise ValueError("io retry backoff/deadline must be >= 0")
         if self.max_save_failures < 0:
             raise ValueError("max_save_failures must be >= 0")
+        if self.on_nonfinite not in ("abort", "skip", "rollback"):
+            raise ValueError(
+                f"on_nonfinite must be abort|skip|rollback, got "
+                f"{self.on_nonfinite!r}")
+        if self.max_rollbacks < 0:
+            raise ValueError("max_rollbacks must be >= 0")
+        if self.dispatch_timeout_s < 0:
+            raise ValueError("dispatch_timeout_s must be >= 0")
+        if self.loss_spike_zscore < 0:
+            raise ValueError("loss_spike_zscore must be >= 0")
         if self.decoded_cache not in ("off", "ram", "disk"):
             raise ValueError(
                 f"decoded_cache must be off|ram|disk, got "

@@ -272,6 +272,7 @@ class CtrPipeline:
         input_workers: int = 0,
         input_worker_slab_records: Optional[int] = None,
         input_worker_death: str = "raise",
+        stall_timeout_s: float = 0.0,
         decoded_cache: str = "off",
         decoded_cache_dir: str = "",
     ):
@@ -322,6 +323,10 @@ class CtrPipeline:
         self.input_workers = max(0, int(input_workers))
         self.input_worker_slab_records = input_worker_slab_records
         self.input_worker_death = input_worker_death
+        # Stall watchdog on ring reads: a wedged-but-alive worker (hung
+        # mount, deadlocked decoder) raises InputStallError instead of
+        # polling forever. 0 = wait indefinitely (the pre-watchdog behavior).
+        self.stall_timeout_s = float(stall_timeout_s)
         # Fault tolerance: one DataHealth/BadRecordPolicy pair per pipeline
         # (skip budget spans every epoch of this pipeline's life); the
         # retry policy governs opens + mid-file reopen-and-seek healing.
@@ -528,6 +533,7 @@ class CtrPipeline:
                 retry_policy=self._retry_policy,
                 health=self.health,
                 on_worker_death=self.input_worker_death,
+                stall_timeout_s=self.stall_timeout_s,
             ).start()
         except Exception as exc:
             import warnings  # noqa: PLC0415

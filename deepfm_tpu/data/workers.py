@@ -170,6 +170,17 @@ class _WorkerDied(Exception):
     """Internal: worker process exited without a protocol farewell."""
 
 
+class InputStallError(RuntimeError):
+    """An input worker is alive but produced nothing for stall_timeout_s.
+
+    Distinct from ``_WorkerDied`` (process gone) — this is the wedged-but-
+    breathing case: a hung filesystem mount, a deadlocked decoder, a worker
+    blocked on a ring slot the consumer will never free. Raising (instead of
+    polling forever) surfaces the stall with diagnostics so a supervisor can
+    restart the job rather than letting it burn accelerator reservations
+    silently."""
+
+
 class ShmInputService:
     """Parent-side fleet manager + globally-ordered chunk iterator.
 
@@ -184,7 +195,8 @@ class ShmInputService:
                  on_bad_record: str = "raise", max_bad_records: int = 0,
                  retry_policy=None, health: Optional[DataHealth] = None,
                  on_worker_death: str = "raise", max_respawns: int = 2,
-                 poll_secs: float = 0.2, fault_die_after: Optional[int] = None):
+                 poll_secs: float = 0.2, fault_die_after: Optional[int] = None,
+                 stall_timeout_s: float = 0.0):
         if on_worker_death not in ("raise", "respawn"):
             raise ValueError(
                 f"on_worker_death must be 'raise' or 'respawn', "
@@ -205,6 +217,7 @@ class ShmInputService:
         self.on_worker_death = on_worker_death
         self.max_respawns = int(max_respawns)
         self._poll_secs = poll_secs
+        self._stall_timeout_s = float(stall_timeout_s)
         self._ctx = mp.get_context(_MP_CTX)
         self._rings: List[shm_ring.ShmRing] = []
         self._procs: List[Optional[mp.process.BaseProcess]] = []
@@ -287,6 +300,7 @@ class ShmInputService:
     # -- message pump ---------------------------------------------------
     def _pop(self, w: int) -> Tuple:
         ring = self._rings[w]
+        waited = 0.0
         while True:
             try:
                 return ring.pop(timeout=self._poll_secs)
@@ -298,6 +312,13 @@ class ShmInputService:
                     return ring.pop(timeout=0)
                 except _queue.Empty:
                     raise _WorkerDied(w) from None
+            waited += self._poll_secs
+            if self._stall_timeout_s > 0 and waited >= self._stall_timeout_s:
+                raise InputStallError(
+                    f"input worker {w} is alive but produced no message for "
+                    f"{waited:.1f}s (stall_timeout_s="
+                    f"{self._stall_timeout_s:g}); data health: "
+                    f"{self.health.summary()}")
 
     def _next_msg(self, w: int) -> Tuple:
         msg = self._pop(w)

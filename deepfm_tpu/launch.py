@@ -16,6 +16,7 @@ from .config import parse_args
 from .parallel import bootstrap
 from .train import tasks
 from .utils import logging as ulog
+from .utils import preempt as preempt_lib
 
 
 def main(argv=None) -> int:
@@ -25,7 +26,17 @@ def main(argv=None) -> int:
     # a later jax.distributed.initialize() (it must run first).
     bootstrap.initialize(cfg)
     ulog.info("config: " + json.dumps(cfg.to_dict(), sort_keys=True))
-    result = tasks.run(cfg)
+    try:
+        result = tasks.run(cfg)
+    except preempt_lib.Preempted as p:
+        # Graceful preemption: the checkpoint + resume sidecar are already
+        # durable (the train task force-saved before raising). The distinct
+        # exit code tells an orchestrator (scripts/supervise.py) "restart
+        # me" as opposed to an ordinary crash.
+        ulog.warning(f"exiting after preemption: {p}")
+        print(json.dumps({"task": cfg.task_type, "preempted": True,
+                          "step": p.step}))
+        return preempt_lib.EXIT_PREEMPTED
     ulog.info(f"task {cfg.task_type} finished: {result}")
     print(json.dumps({"task": cfg.task_type, **result}))
     return 0

@@ -1,0 +1,346 @@
+"""Training-runtime numerical guard + stall watchdog + health accounting.
+
+The reference had no defense between a poisoned batch and the optimizer
+state: a single non-finite loss silently corrupted the parameters and the
+job trained garbage until someone read the logs. This module is the
+training-plane counterpart of ``data/health.py``:
+
+  * :class:`TrainHealth` — thread-safe counters for every runtime fault the
+    loop survived (preemptions, non-finite skips, rollbacks, watchdog
+    aborts, loss spikes, corrupt resume sidecars), logged per epoch, merged
+    into the train-task result dict and emitted to TensorBoard.
+  * :class:`NonFiniteGuard` — per-dispatch non-finite loss/param detection
+    plus an EMA z-score loss-spike detector, with the configurable
+    ``--on_nonfinite {abort,skip,rollback}`` policy. ``skip`` drops the
+    poisoned dispatch's update (the next superbatch trains against the
+    pre-update state); ``rollback`` asks the task driver (via
+    :class:`RollbackSignal`) to restore the last checkpoint and replay from
+    its recorded offset. Both are bounded by ``--max_rollbacks``.
+  * :class:`StallWatchdog` — a monitor thread that aborts the process with
+    a diagnostic dump (current step, last progress time, per-worker
+    ``DataHealth`` snapshot) when no dispatch completes within
+    ``--dispatch_timeout_s`` — the defense against a hung peer or wedged
+    input worker blocking a multi-process job forever.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from ..utils import logging as ulog
+from ..utils import preempt as preempt_lib
+
+
+class TrainHealth:
+    """Thread-safe counters for runtime faults survived by the train loop.
+
+    The training-plane mirror of ``data.health.DataHealth`` — same
+    snapshot/merge/summary surface so the task driver folds both into one
+    result dict.
+    """
+
+    COUNTERS = ("preemptions", "nonfinite_skips", "rollbacks",
+                "watchdog_aborts", "loss_spikes", "resume_meta_corrupt")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.preemptions = 0          # preempt saves taken (then exited 42)
+        self.nonfinite_skips = 0      # poisoned dispatch updates dropped
+        self.rollbacks = 0            # checkpoint restores after non-finite
+        self.watchdog_aborts = 0      # dispatch-timeout aborts fired
+        self.loss_spikes = 0          # EMA z-score outliers (warned only)
+        self.resume_meta_corrupt = 0  # unreadable resume sidecars tolerated
+        self._dirty = False
+
+    def _bump(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, name, getattr(self, name) + n)
+            self._dirty = True
+
+    def record_preemption(self) -> None:
+        self._bump("preemptions")
+
+    def record_nonfinite_skip(self) -> None:
+        self._bump("nonfinite_skips")
+
+    def record_rollback(self) -> None:
+        self._bump("rollbacks")
+
+    def record_watchdog_abort(self) -> None:
+        self._bump("watchdog_aborts")
+
+    def record_loss_spike(self) -> None:
+        self._bump("loss_spikes")
+
+    def record_resume_meta_corrupt(self) -> None:
+        self._bump("resume_meta_corrupt")
+
+    @property
+    def total_events(self) -> int:
+        with self._lock:
+            return sum(getattr(self, k) for k in self.COUNTERS)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {k: int(getattr(self, k)) for k in self.COUNTERS}
+
+    def merge_into(self, totals: Dict[str, float]) -> None:
+        """Accumulate counters into ``totals`` (the train-task result dict)."""
+        for k, v in self.snapshot().items():
+            totals[k] = totals.get(k, 0) + v
+
+    def summary(self) -> str:
+        snap = self.snapshot()
+        return " ".join(f"{k}={v}" for k, v in snap.items())
+
+    def consume_dirty(self) -> bool:
+        with self._lock:
+            dirty, self._dirty = self._dirty, False
+            return dirty
+
+
+class NonFiniteError(RuntimeError):
+    """A non-finite loss/params under ``on_nonfinite=abort`` (or a skip/
+    rollback budget exhausted). The message carries the step number."""
+
+
+class RollbackSignal(Exception):
+    """Internal control flow: the fit loop requests a checkpoint rollback.
+
+    Caught by the train-task driver, which restores the latest checkpoint
+    and replays from its recorded resume offset.
+    """
+
+    def __init__(self, step: int, detail: str = ""):
+        super().__init__(f"rollback requested at step {step}"
+                         + (f": {detail}" if detail else ""))
+        self.step = int(step)
+
+
+POLICIES = ("abort", "skip", "rollback")
+
+
+class NonFiniteGuard:
+    """Per-dispatch non-finite detection + EMA z-score spike detector.
+
+    ``observe(loss, step, params_bad=...)`` classifies one dispatch and
+    returns ``"ok"`` / ``"skip"`` / ``"rollback"``; under ``abort`` (or
+    once the shared skip/rollback budget ``max_events`` is spent) it raises
+    :class:`NonFiniteError` naming the step.
+
+    Cost note (TUNING §2.8): ``skip``/``rollback`` must intercept the
+    poisoned state before the next dispatch consumes it, so the fit loop
+    syncs the loss scalar once per dispatch — trading a little dispatch
+    pipelining for the guarantee. ``abort`` piggybacks on the log-cadence
+    sync instead and adds zero per-dispatch cost.
+
+    The spike detector is advisory: it maintains an exponential moving
+    mean/variance of the (finite) loss and warns + counts when
+    ``|loss - ema| / std`` exceeds ``spike_zscore`` after ``spike_warmup``
+    observations. It never skips or aborts — a genuine loss spike with
+    finite values is information, not corruption.
+    """
+
+    def __init__(self, policy: str = "abort", max_events: int = 3,
+                 health: Optional[TrainHealth] = None,
+                 spike_zscore: float = 0.0, spike_warmup: int = 20,
+                 ema_alpha: float = 0.1):
+        if policy not in POLICIES:
+            raise ValueError(
+                f"on_nonfinite must be one of {POLICIES}, got {policy!r}")
+        self.policy = policy
+        self.max_events = int(max_events)
+        self.health = health if health is not None else TrainHealth()
+        self.spike_zscore = float(spike_zscore)
+        self.spike_warmup = int(spike_warmup)
+        self._alpha = float(ema_alpha)
+        self._events = 0
+        self._ema = 0.0
+        self._var = 0.0
+        self._n_obs = 0
+        self._params_check: Optional[Callable] = None
+
+    @property
+    def per_dispatch(self) -> bool:
+        """True when the fit loop must sync + check every dispatch."""
+        return self.policy in ("skip", "rollback")
+
+    @property
+    def events(self) -> int:
+        return self._events
+
+    @classmethod
+    def from_config(cls, cfg: Any, health: Optional[TrainHealth] = None
+                    ) -> "NonFiniteGuard":
+        return cls(policy=cfg.on_nonfinite, max_events=cfg.max_rollbacks,
+                   health=health, spike_zscore=cfg.loss_spike_zscore)
+
+    # -- param check -----------------------------------------------------
+    def params_nonfinite(self, state: Any) -> bool:
+        """True when any inexact param leaf holds a non-finite value. One
+        fused on-device all-isfinite reduction; the bool fetch is cheap
+        because the caller has already synced the dispatch's loss."""
+        import jax  # noqa: PLC0415 (keep module importable without jax)
+        import jax.numpy as jnp  # noqa: PLC0415
+
+        if self._params_check is None:
+            def all_finite(params):
+                ok = jnp.bool_(True)
+                for leaf in jax.tree.leaves(params):
+                    if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact):
+                        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(leaf)))
+                return ok
+            self._params_check = jax.jit(all_finite)
+        return not bool(self._params_check(state.params))
+
+    # -- spike detector --------------------------------------------------
+    def _observe_spike(self, loss: float, step: int) -> None:
+        if self.spike_zscore <= 0.0:
+            return
+        self._n_obs += 1
+        if self._n_obs == 1:
+            self._ema = loss
+            self._var = 0.0
+            return
+        dev = loss - self._ema
+        if self._n_obs > self.spike_warmup:
+            std = math.sqrt(max(self._var, 1e-12))
+            z = abs(dev) / std
+            if z > self.spike_zscore:
+                self.health.record_loss_spike()
+                ulog.warning(
+                    f"loss spike at step {step}: loss={loss:.5f} is "
+                    f"{z:.1f} sigma from EMA {self._ema:.5f} "
+                    f"(threshold {self.spike_zscore}); continuing")
+                # A spike must not poison its own baseline.
+                return
+        self._ema += self._alpha * dev
+        self._var = (1 - self._alpha) * (self._var + self._alpha * dev * dev)
+
+    # -- the per-dispatch verdict ---------------------------------------
+    def observe(self, loss: float, step: int, *,
+                params_bad: bool = False) -> str:
+        """Classify one completed dispatch. Returns 'ok' | 'skip' |
+        'rollback'; raises :class:`NonFiniteError` for abort or a spent
+        budget. ``step`` is the global step AFTER the dispatch."""
+        bad = (not math.isfinite(loss)) or params_bad
+        if not bad:
+            self._observe_spike(loss, step)
+            return "ok"
+        what = (f"non-finite loss ({loss})" if not math.isfinite(loss)
+                else "non-finite parameters")
+        if self.policy == "abort":
+            raise NonFiniteError(
+                f"{what} at step {step} (on_nonfinite=abort)")
+        self._events += 1
+        if self._events > self.max_events:
+            raise NonFiniteError(
+                f"{what} at step {step}: non-finite budget exhausted "
+                f"({self._events} events > max_rollbacks={self.max_events})")
+        if self.policy == "skip":
+            self.health.record_nonfinite_skip()
+            ulog.warning(
+                f"{what} at step {step}: dropping this dispatch's update "
+                f"(on_nonfinite=skip, event {self._events}/"
+                f"{self.max_events})")
+            return "skip"
+        ulog.warning(
+            f"{what} at step {step}: rolling back to the last checkpoint "
+            f"(on_nonfinite=rollback, event {self._events}/"
+            f"{self.max_events})")
+        return "rollback"
+
+
+class StallWatchdog:
+    """Abort-with-diagnostics when no dispatch completes within the timeout.
+
+    The fit loop calls :meth:`beat` after every completed dispatch; a
+    monitor thread checks the time since the last beat and, past
+    ``timeout_s``, logs a diagnostic dump — current step, seconds since
+    progress, and the input pipeline's per-worker ``DataHealth`` snapshot —
+    then calls ``abort`` (default: ``os._exit(EXIT_WATCHDOG)``, because a
+    stalled dispatch is usually blocked in native code where an in-thread
+    exception cannot land). ``clock`` is injectable for sleep-free tests.
+    """
+
+    def __init__(self, timeout_s: float, *,
+                 health: Optional[TrainHealth] = None,
+                 data_health: Any = None,
+                 abort: Optional[Callable[[str], None]] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 poll_s: Optional[float] = None,
+                 name: str = "train"):
+        self.timeout_s = float(timeout_s)
+        self.health = health
+        self._data_health = data_health
+        self._abort = abort if abort is not None else self._default_abort
+        self._clock = clock
+        self._poll = (poll_s if poll_s is not None
+                      else max(min(self.timeout_s / 4.0, 1.0), 0.01))
+        self._name = name
+        self._lock = threading.Lock()
+        self._last = self._clock()
+        self._step = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.fired = False
+
+    @staticmethod
+    def _default_abort(dump: str) -> None:  # noqa: ARG004
+        os._exit(preempt_lib.EXIT_WATCHDOG)
+
+    def beat(self, step: int) -> None:
+        with self._lock:
+            self._last = self._clock()
+            self._step = int(step)
+
+    def _dump(self, waited: float) -> str:
+        lines = [f"stall watchdog ({self._name}): no dispatch completed in "
+                 f"{waited:.1f}s (dispatch_timeout_s={self.timeout_s})",
+                 f"  last progress: step {self._step}, {waited:.1f}s ago"]
+        dh = self._data_health
+        if dh is not None:
+            try:
+                lines.append(f"  data health: {dh.summary()}")
+            except Exception:
+                pass
+        if self.health is not None:
+            lines.append(f"  train health: {self.health.summary()}")
+        return "\n".join(lines)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll):
+            with self._lock:
+                waited = self._clock() - self._last
+            if waited >= self.timeout_s:
+                self.fired = True
+                if self.health is not None:
+                    self.health.record_watchdog_abort()
+                dump = self._dump(waited)
+                ulog.error(dump)
+                self._abort(dump)
+                return
+
+    def start(self) -> "StallWatchdog":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name=f"stall-watchdog-{self._name}",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self) -> "StallWatchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -19,6 +19,7 @@ The fit loop feeds host batches via ``jax.make_array_from_process_local_data``
 
 from __future__ import annotations
 
+import math
 import time
 from typing import Any, Callable, Dict, Iterable, Iterator, Optional, Tuple
 
@@ -45,6 +46,7 @@ from ..models import get_model
 from ..parallel import mesh as mesh_lib
 from ..utils import logging as ulog
 from ..utils import profiling as prof_lib
+from . import guard as guard_lib
 from . import metrics as metrics_lib
 from . import optimizers as opt_lib
 from .state import TrainState
@@ -104,6 +106,13 @@ class Trainer:
         # (steps, batch) shape.
         self._dd_cols: Optional[Tuple[str, Dict[str, jax.Array]]] = None
         self._dd_programs: Dict[Tuple[int, int], Callable] = {}
+        # on_nonfinite=skip must keep the pre-dispatch state alive to drop a
+        # poisoned update, so the step programs cannot donate their input
+        # state buffer under that policy (the cost of the safety net; see
+        # TUNING §2.8).
+        self._donate_state = cfg.on_nonfinite != "skip"
+        # Injectable watchdog abort (tests); None = os._exit(EXIT_WATCHDOG).
+        self.watchdog_abort: Optional[Callable[[str], None]] = None
 
     # ------------------------------------------------------------------
     # State creation / placement
@@ -218,8 +227,9 @@ class Trainer:
             return self._step_impl(
                 state, batch, data_axis=data_axis, shard_axis=shard_axis)
 
+        donate = (0,) if self._donate_state else ()
         if mi.mesh is None:
-            return jax.jit(step, donate_argnums=0)
+            return jax.jit(step, donate_argnums=donate)
         specs = self._dummy_specs()
         return jax.jit(
             shard_map(
@@ -227,7 +237,7 @@ class Trainer:
                 in_specs=(specs["state"], specs["batch"]),
                 out_specs=(specs["state"], P()),
                 check_vma=True),
-            donate_argnums=0)
+            donate_argnums=donate)
 
     def _make_train_multi_step(self) -> Callable:
         """K optimizer steps in ONE dispatch: lax.scan over a stacked batch
@@ -251,8 +261,9 @@ class Trainer:
 
         # Donate only the state: scanned batch buffers are not reusable as
         # outputs (XLA reports them unusable and warns).
+        donate = (0,) if self._donate_state else ()
         if mi.mesh is None:
-            return jax.jit(multi, donate_argnums=0)
+            return jax.jit(multi, donate_argnums=donate)
         specs = self._dummy_specs()
         sb_specs = jax.tree.map(lambda s: P(None, *s), specs["batch"])
         return jax.jit(
@@ -261,7 +272,7 @@ class Trainer:
                 in_specs=(specs["state"], sb_specs),
                 out_specs=(specs["state"], P()),
                 check_vma=True),
-            donate_argnums=0)
+            donate_argnums=donate)
 
     @property
     def multi_step(self) -> Callable:
@@ -647,6 +658,26 @@ class Trainer:
             if close is not None:
                 close()
 
+    def _guard_verdict(self, guard: "guard_lib.NonFiniteGuard",
+                       state: TrainState, m: Dict[str, Any]) -> str:
+        """Per-dispatch guard check for the skip/rollback policies: sync the
+        dispatch's loss (the one extra device read those policies pay), run
+        the on-device all-isfinite param reduce, classify. Shared by the
+        staged and device-resident fit loops."""
+        loss = float(m["loss"])
+        params_bad = (guard.params_nonfinite(state)
+                      if math.isfinite(loss) else False)
+        return guard.observe(loss, int(state.step), params_bad=params_bad)
+
+    def _make_watchdog(self, guard, data_health
+                       ) -> Optional["guard_lib.StallWatchdog"]:
+        if self.cfg.dispatch_timeout_s <= 0:
+            return None
+        return guard_lib.StallWatchdog(
+            self.cfg.dispatch_timeout_s,
+            health=guard.health if guard is not None else None,
+            data_health=data_health, abort=self.watchdog_abort).start()
+
     def fit(
         self,
         state: TrainState,
@@ -655,16 +686,26 @@ class Trainer:
         hooks: Optional[list] = None,
         max_steps: Optional[int] = None,
         on_log: Optional[Callable[[int, float, float], None]] = None,
+        guard: Optional["guard_lib.NonFiniteGuard"] = None,
     ) -> Tuple[TrainState, Dict[str, float]]:
         """Run the train loop over an iterable of host batches.
 
         Dispatches ``cfg.steps_per_loop`` optimizer steps per host round trip
         (one stacked transfer + one lax.scan program); hooks fire once per
         dispatch with ``metrics["steps_done"]`` = number of steps taken.
+
+        ``guard`` (a :class:`guard_lib.NonFiniteGuard`) enables the
+        non-finite policy: under ``abort`` it piggybacks on the log-cadence
+        loss sync; under ``skip``/``rollback`` every dispatch is checked
+        before its update is accepted — a skip restores the pre-dispatch
+        state and fires no hooks (the dropped dispatch never happened), a
+        rollback raises :class:`guard_lib.RollbackSignal` for the task
+        driver to restore the last checkpoint.
         """
         cfg = self.cfg
         k = max(cfg.steps_per_loop, 1)
         world = jax.process_count() if self.mesh_info.mesh is not None else 1
+        src_health = getattr(batches, "health", None)
         if max_steps is not None:
             import itertools  # noqa: PLC0415
             batches = itertools.islice(iter(batches), max_steps)
@@ -679,46 +720,84 @@ class Trainer:
             staged_iter = self._stage_multiprocess(batches, k, depth)
         else:
             staged_iter = self._stage(batches, k, depth)
+        guard_active = guard is not None and guard.per_dispatch
+        watchdog = self._make_watchdog(guard, src_health)
         last_loss = float("nan")
         t0 = time.time()
         examples_since_log = 0
         n_steps = 0
         m: Dict[str, Any] = {}
+        prev_state: Optional[TrainState] = None
         meter = prof_lib.ThroughputMeter()
-        for dev_batch, steps_done, local_ex in staged_iter:
-            if steps_done == 1:
-                state, m = self.train_step(state, dev_batch)
-            else:
-                state, m = self.multi_step(state, dev_batch)
-            prev_steps = n_steps
-            n_steps += steps_done
-            examples_since_log += local_ex * world
-            meter.update(local_ex * world, steps_done)
-            if cfg.log_steps and (n_steps // cfg.log_steps
-                                  > prev_steps // cfg.log_steps):
-                loss = float(m["loss"])  # device sync, bounded by log cadence
-                gstep = int(state.step)
-                last_loss = loss
-                dt = time.time() - t0
-                eps = examples_since_log / max(dt, 1e-9)
-                ulog.info(
-                    f"step={gstep} loss={loss:.5f} examples/sec={eps:,.0f}")
-                health = getattr(batches, "health", None)
-                if health is not None and health.consume_dirty():
-                    # Fault events (healed retries / skipped records) since
-                    # the last log line — same cadence as the loss log.
-                    ulog.info(f"data health: {health.summary()}")
-                if on_log is not None:
-                    # Same cadence as the log line: loss/step were already
-                    # synced above, so the callback adds no device reads.
-                    on_log(gstep, loss, eps)
-                t0 = time.time()
-                examples_since_log = 0
-            if hooks:
-                m = dict(m)
-                m["steps_done"] = steps_done
-                for hook in hooks:
-                    hook(state, m)
+        try:
+            for dev_batch, steps_done, local_ex in staged_iter:
+                if guard_active:
+                    # Donation is off under skip (see __init__), so the
+                    # pre-dispatch state stays valid for a dropped update.
+                    prev_state, prev_m = state, m
+                if steps_done == 1:
+                    state, m = self.train_step(state, dev_batch)
+                else:
+                    state, m = self.multi_step(state, dev_batch)
+                if guard_active:
+                    verdict = self._guard_verdict(guard, state, m)
+                    if verdict == "skip":
+                        # The poisoned batch is consumed; its update is not.
+                        # No hooks, no step count: the dispatch never
+                        # happened as far as checkpoints/logs are concerned.
+                        state, m = prev_state, prev_m
+                        if watchdog is not None:
+                            watchdog.beat(n_steps)
+                        continue
+                    if verdict == "rollback":
+                        raise guard_lib.RollbackSignal(int(state.step))
+                prev_steps = n_steps
+                n_steps += steps_done
+                examples_since_log += local_ex * world
+                meter.update(local_ex * world, steps_done)
+                if watchdog is not None:
+                    watchdog.beat(n_steps)
+                if cfg.log_steps and (n_steps // cfg.log_steps
+                                      > prev_steps // cfg.log_steps):
+                    loss = float(m["loss"])  # device sync, bounded by log cadence
+                    gstep = int(state.step)
+                    last_loss = loss
+                    if guard is not None and not guard_active:
+                        # abort policy: reuse the loss scalar this log line
+                        # already synced — zero extra dispatch cost.
+                        guard.observe(
+                            loss, gstep,
+                            params_bad=(guard.params_nonfinite(state)
+                                        if math.isfinite(loss) else False))
+                    dt = time.time() - t0
+                    eps = examples_since_log / max(dt, 1e-9)
+                    ulog.info(
+                        f"step={gstep} loss={loss:.5f} examples/sec={eps:,.0f}")
+                    health = getattr(batches, "health", None)
+                    if health is not None and health.consume_dirty():
+                        # Fault events (healed retries / skipped records) since
+                        # the last log line — same cadence as the loss log.
+                        ulog.info(f"data health: {health.summary()}")
+                    if on_log is not None:
+                        # Same cadence as the log line: loss/step were already
+                        # synced above, so the callback adds no device reads.
+                        on_log(gstep, loss, eps)
+                    t0 = time.time()
+                    examples_since_log = 0
+                if hooks:
+                    m = dict(m)
+                    m["steps_done"] = steps_done
+                    for hook in hooks:
+                        hook(state, m)
+        finally:
+            if watchdog is not None:
+                watchdog.stop()
+            # A mid-loop exception (rollback, preemption, abort) abandons the
+            # staging generator; close it so prefetch threads, input-service
+            # workers and file handles release before any retry attempt.
+            close = getattr(staged_iter, "close", None)
+            if close is not None:
+                close()
         if n_steps:
             # Fold the async-dispatch drain into the measurement window so
             # the meter reports completed-on-device throughput, not host
@@ -857,7 +936,7 @@ class Trainer:
         # Plain jit even under a (pure-data) mesh: inputs carry their
         # shardings and GSPMD partitions the gather + step; the global-mean
         # gradient math is identical to the single-device formulation.
-        prog = jax.jit(run, donate_argnums=0)
+        prog = jax.jit(run, donate_argnums=(0,) if self._donate_state else ())
         self._dd_programs[key] = prog
         return prog
 
@@ -869,6 +948,7 @@ class Trainer:
         hooks: Optional[list] = None,
         max_steps: Optional[int] = None,
         on_log: Optional[Callable[[int, float, float], None]] = None,
+        guard: Optional["guard_lib.NonFiniteGuard"] = None,
     ) -> Tuple[TrainState, Dict[str, float]]:
         """Train with the whole decoded dataset resident on device.
 
@@ -876,7 +956,7 @@ class Trainer:
         Mirrors ``fit``'s contract: same dispatch grouping as the staged
         pooled pipeline (k-step superbatches, then single batches, then the
         short remainder unless ``drop_remainder``), same hook/log/meter
-        cadence, same return dict.
+        cadence, same guard semantics, same return dict.
         """
         cfg = self.cfg
         k = max(cfg.steps_per_loop, 1)
@@ -892,56 +972,84 @@ class Trainer:
         n_steps = 0
         m: Dict[str, Any] = {}
         health = getattr(pipe, "health", None)
-        for e in range(pipe.num_epochs):
-            if remaining is not None and remaining <= 0:
-                break
-            epoch = e + getattr(pipe, "epoch_offset", 0)
-            idx_dev = self._dd_put_indices(pipe.device_epoch_indices(epoch, k))
-            # The staged pool's emission plan for one epoch, as batch sizes.
-            n_batches = n // bs
-            r = n - n_batches * bs
-            sizes = [bs] * n_batches
-            if r and not pipe.drop_remainder:
-                sizes.append(r)
-            if remaining is not None:
-                sizes = sizes[:remaining]
-                remaining -= len(sizes)
-            start = 0
-            i = 0
-            while i < len(sizes):
-                if (sizes[i] == bs and i + k <= len(sizes)
-                        and sizes[i + k - 1] == bs):
-                    mm, bsz = k, bs
-                else:
-                    mm, bsz = 1, sizes[i]
-                prog = self._dd_program(mm, bsz)
-                state, m = prog(state, dev_cols, idx_dev, np.int32(start))
-                start += mm * bsz
-                i += mm
-                prev_steps = n_steps
-                n_steps += mm
-                examples_since_log += mm * bsz
-                meter.update(mm * bsz, mm)
-                if cfg.log_steps and (n_steps // cfg.log_steps
-                                      > prev_steps // cfg.log_steps):
-                    loss = float(m["loss"])
-                    gstep = int(state.step)
-                    last_loss = loss
-                    dt = time.time() - t0
-                    eps = examples_since_log / max(dt, 1e-9)
-                    ulog.info(f"step={gstep} loss={loss:.5f} "
-                              f"examples/sec={eps:,.0f}")
-                    if health is not None and health.consume_dirty():
-                        ulog.info(f"data health: {health.summary()}")
-                    if on_log is not None:
-                        on_log(gstep, loss, eps)
-                    t0 = time.time()
-                    examples_since_log = 0
-                if hooks:
-                    m = dict(m)
-                    m["steps_done"] = mm
-                    for hook in hooks:
-                        hook(state, m)
+        guard_active = guard is not None and guard.per_dispatch
+        watchdog = self._make_watchdog(guard, health)
+        try:
+            for e in range(pipe.num_epochs):
+                if remaining is not None and remaining <= 0:
+                    break
+                epoch = e + getattr(pipe, "epoch_offset", 0)
+                idx_dev = self._dd_put_indices(
+                    pipe.device_epoch_indices(epoch, k))
+                # The staged pool's emission plan for one epoch, as batch
+                # sizes.
+                n_batches = n // bs
+                r = n - n_batches * bs
+                sizes = [bs] * n_batches
+                if r and not pipe.drop_remainder:
+                    sizes.append(r)
+                if remaining is not None:
+                    sizes = sizes[:remaining]
+                    remaining -= len(sizes)
+                start = 0
+                i = 0
+                while i < len(sizes):
+                    if (sizes[i] == bs and i + k <= len(sizes)
+                            and sizes[i + k - 1] == bs):
+                        mm, bsz = k, bs
+                    else:
+                        mm, bsz = 1, sizes[i]
+                    prog = self._dd_program(mm, bsz)
+                    if guard_active:
+                        prev_state, prev_m = state, m
+                    state, m = prog(state, dev_cols, idx_dev, np.int32(start))
+                    # The dispatch's rows are consumed whether or not its
+                    # update survives the guard.
+                    start += mm * bsz
+                    i += mm
+                    if guard_active:
+                        verdict = self._guard_verdict(guard, state, m)
+                        if verdict == "skip":
+                            state, m = prev_state, prev_m
+                            if watchdog is not None:
+                                watchdog.beat(n_steps)
+                            continue
+                        if verdict == "rollback":
+                            raise guard_lib.RollbackSignal(int(state.step))
+                    prev_steps = n_steps
+                    n_steps += mm
+                    examples_since_log += mm * bsz
+                    meter.update(mm * bsz, mm)
+                    if watchdog is not None:
+                        watchdog.beat(n_steps)
+                    if cfg.log_steps and (n_steps // cfg.log_steps
+                                          > prev_steps // cfg.log_steps):
+                        loss = float(m["loss"])
+                        gstep = int(state.step)
+                        last_loss = loss
+                        if guard is not None and not guard_active:
+                            guard.observe(
+                                loss, gstep,
+                                params_bad=(guard.params_nonfinite(state)
+                                            if math.isfinite(loss) else False))
+                        dt = time.time() - t0
+                        eps = examples_since_log / max(dt, 1e-9)
+                        ulog.info(f"step={gstep} loss={loss:.5f} "
+                                  f"examples/sec={eps:,.0f}")
+                        if health is not None and health.consume_dirty():
+                            ulog.info(f"data health: {health.summary()}")
+                        if on_log is not None:
+                            on_log(gstep, loss, eps)
+                        t0 = time.time()
+                        examples_since_log = 0
+                    if hooks:
+                        m = dict(m)
+                        m["steps_done"] = mm
+                        for hook in hooks:
+                            hook(state, m)
+        finally:
+            if watchdog is not None:
+                watchdog.stop()
         if n_steps:
             jax.block_until_ready(m["loss"])
             meter.record_drain()

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import glob as _glob
 import os
+import time
 import warnings
 from typing import Dict, List, Optional, Tuple
 
@@ -35,9 +36,12 @@ from ..data import sharding as shard_lib
 from ..parallel import bootstrap
 from ..utils import checkpoint as ckpt_lib
 from ..utils import export as export_lib
+from ..utils import faults as faults_lib
 from ..utils import logging as ulog
+from ..utils import preempt as preempt_lib
 from ..utils import profiling as prof_lib
 from ..utils import retry as retry_lib
+from . import guard as guard_lib
 from .loop import Trainer, pad_batch
 from .state import TrainState
 
@@ -196,6 +200,7 @@ def make_pipeline(cfg: Config, files: List[str], *, epochs: int = 1,
         use_native_decoder=cfg.use_native_decoder,
         reader_threads=cfg.reader_threads,
         input_workers=cfg.input_workers,
+        stall_timeout_s=cfg.dispatch_timeout_s,
         verify_crc=cfg.verify_crc,
         **_fault_tolerance_kwargs(cfg),
     )
@@ -243,7 +248,8 @@ def make_streaming_pipeline(cfg: Config, files: List[str], *, epochs: int = 1,
 
 
 def _fit_epoch(trainer: Trainer, cfg: Config, state: TrainState, pipeline,
-               hooks, on_log) -> Tuple[TrainState, Dict[str, float]]:
+               hooks, on_log, guard=None
+               ) -> Tuple[TrainState, Dict[str, float]]:
     """One epoch of training: device-resident when ``--device_dataset`` is
     set and the run qualifies, otherwise the staged host pipeline. The
     fallback warns with the disqualifier so an operator expecting device
@@ -252,11 +258,12 @@ def _fit_epoch(trainer: Trainer, cfg: Config, state: TrainState, pipeline,
         reason = trainer.device_dataset_ineligible(pipeline)
         if reason is None:
             return trainer.fit_device_resident(
-                state, pipeline, hooks=hooks, on_log=on_log)
+                state, pipeline, hooks=hooks, on_log=on_log, guard=guard)
         warnings.warn(
             f"--device_dataset fell back to the staged input path: {reason}",
             RuntimeWarning, stacklevel=2)
-    return trainer.fit(state, pipeline, hooks=hooks, on_log=on_log)
+    return trainer.fit(state, pipeline, hooks=hooks, on_log=on_log,
+                       guard=guard)
 
 
 def _restore_or_init(trainer: Trainer, cfg: Config, require: bool,
@@ -285,7 +292,8 @@ def _restore_or_init(trainer: Trainer, cfg: Config, require: bool,
     own = mgr is None
     if own:
         mgr = ckpt_lib.CheckpointManager(
-            cfg.model_dir, max_to_keep=cfg.keep_checkpoint_max)
+            cfg.model_dir, max_to_keep=cfg.keep_checkpoint_max,
+            retry_policy=retry_lib.policy_from_config(cfg))
     try:
         if mgr.latest_step() is not None:
             state = mgr.restore(state)
@@ -395,7 +403,12 @@ def _write_resume_meta(model_dir: str, meta: Dict) -> None:
         json.dump(meta, f)
 
 
-def _read_resume_meta(model_dir: str) -> Optional[Dict]:
+def _read_resume_meta(model_dir: str,
+                      health: Optional[guard_lib.TrainHealth] = None
+                      ) -> Optional[Dict]:
+    """Read the resume sidecar; a corrupt/truncated file (a preemption can
+    land mid-json.dump) degrades to checkpoint-step-only resume — warn and
+    count it, never raise: the checkpoint itself is still good."""
     import json  # noqa: PLC0415
     path = fileio.join(model_dir, _RESUME_META)
     if not fileio.exists(path):
@@ -403,7 +416,12 @@ def _read_resume_meta(model_dir: str) -> Optional[Dict]:
     try:
         with fileio.open_stream(path, "r") as f:
             return json.load(f)
-    except (ValueError, OSError):  # torn write / unreadable: ignore
+    except (ValueError, OSError) as exc:  # torn write / unreadable
+        ulog.warning(
+            f"resume sidecar {path} unreadable ({exc!r}); falling back to "
+            f"checkpoint-step-only resume (the interrupted epoch replays)")
+        if health is not None:
+            health.record_resume_meta_corrupt()
         return None
 
 
@@ -474,7 +492,9 @@ def _consumption_layout(cfg: Config) -> List[int]:
 
 
 def _resume_position(cfg: Config, restored_step: int,
-                     files_digest: str = "") -> Tuple[int, int, int]:
+                     files_digest: str = "",
+                     health: Optional[guard_lib.TrainHealth] = None
+                     ) -> Tuple[int, int, int]:
     """(epoch_base, start_epoch, skip_batches) for this invocation.
 
     The sidecar applies only when its ``step`` matches the restored
@@ -484,7 +504,8 @@ def _resume_position(cfg: Config, restored_step: int,
     so shuffle orders never repeat across resume-for-more-epochs runs; an
     interrupted invocation with the same num_epochs/pipe_mode resumes
     mid-epoch, skipping the batches already trained."""
-    meta = _read_resume_meta(cfg.model_dir) if cfg.model_dir else None
+    meta = (_read_resume_meta(cfg.model_dir, health=health)
+            if cfg.model_dir else None)
     if not meta or not restored_step:
         return 0, 0, 0
     base = int(meta.get("epoch_base", 0))
@@ -570,9 +591,18 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
         mgr = ckpt_lib.CheckpointManager(
             cfg.model_dir, max_to_keep=cfg.keep_checkpoint_max,
             save_interval_steps=cfg.save_checkpoints_steps,
-            max_save_failures=cfg.max_save_failures)
+            max_save_failures=cfg.max_save_failures,
+            retry_policy=retry_lib.policy_from_config(cfg))
     state = _restore_or_init(trainer, cfg, require=False, mgr=mgr)
-    restored_step = int(state.step)
+
+    # Runtime-resilience plumbing: ONE TrainHealth + guard for the whole run
+    # (the skip/rollback budget spans rollback attempts) and the
+    # process-wide preemption listener. A flag already set (a notice that
+    # arrived during startup) is honored at the first dispatch.
+    train_health = guard_lib.TrainHealth()
+    guard = guard_lib.NonFiniteGuard.from_config(cfg, health=train_health)
+    listener = preempt_lib.get_listener()
+
     # The resume decision is computed on the CHIEF ONLY and broadcast to all
     # ranks: a rank deciding from its own filesystem view (transient stat
     # failure, eventually-consistent object-store metadata, or a multi-path
@@ -582,19 +612,24 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
     # rank-consistent (all ranks restore the same global checkpoint).
     files_digest = (_files_fingerprint(cfg, tr_files)
                     if bootstrap.is_chief() else "")
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils  # noqa: PLC0415
-        pos = (_resume_position(cfg, restored_step, files_digest)
-               if bootstrap.is_chief() else (0, 0, 0))
-        pos = multihost_utils.broadcast_one_to_all(np.asarray(pos, np.int64))
-        epoch_base, start_epoch, skip_batches = (int(x) for x in pos)
-    else:
-        epoch_base, start_epoch, skip_batches = _resume_position(
-            cfg, restored_step, files_digest)
-    if start_epoch or skip_batches:
-        ulog.info(f"step-accurate resume: epoch {start_epoch} "
-                  f"(+{skip_batches} batches already trained), "
-                  f"epoch_base={epoch_base}")
+
+    def _resume_for(restored_step: int) -> Tuple[int, int, int]:
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils  # noqa: PLC0415
+            pos = (_resume_position(cfg, restored_step, files_digest,
+                                    health=train_health)
+                   if bootstrap.is_chief() else (0, 0, 0))
+            pos = multihost_utils.broadcast_one_to_all(
+                np.asarray(pos, np.int64))
+            epoch_base, start_epoch, skip_batches = (int(x) for x in pos)
+        else:
+            epoch_base, start_epoch, skip_batches = _resume_position(
+                cfg, restored_step, files_digest, health=train_health)
+        if start_epoch or skip_batches:
+            ulog.info(f"step-accurate resume: epoch {start_epoch} "
+                      f"(+{skip_batches} batches already trained), "
+                      f"epoch_base={epoch_base}")
+        return epoch_base, start_epoch, skip_batches
 
     # train_and_evaluate semantics (reference 1-ps-cpu/...py:440-442,
     # REQUIRED there per README-EN.md:36-38): mid-train eval no earlier than
@@ -625,41 +660,92 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
         _log_health(pipe, where)
         return ev
 
-    # Data-pipeline position for the resume sidecar; epoch_start is the
-    # global step at which the current epoch's batch 0 was (or would have
-    # been) trained, so steps_into_epoch == batches consumed this epoch.
-    progress = {"epoch": start_epoch,
-                "epoch_start": restored_step - skip_batches}
-
-    def _meta(step: int, completed: bool) -> Dict:
-        return {"step": step, "epoch": progress["epoch"],
-                "steps_into_epoch": step - progress["epoch_start"],
-                "epoch_base": epoch_base, "num_epochs": cfg.num_epochs,
-                "pipe_mode": int(cfg.pipe_mode),
-                "layout": _consumption_layout(cfg), "files": files_digest,
-                "completed": completed}
-
     tb = _TensorBoardWriter(cfg.tensorboard_dir)
 
     def _tb_log(step: int, loss: float, eps: float) -> None:
         tb.scalars(step, loss=loss, examples_per_sec=eps)
 
-    def _tb_eval(ev: Dict[str, float], at_state: Optional[TrainState] = None
-                 ) -> None:
-        s = state if at_state is None else at_state
-        tb.scalars(int(s.step), eval_auc=ev["auc"], eval_loss=ev["loss"])
+    def _tb_eval(ev: Dict[str, float], at_state: TrainState) -> None:
+        tb.scalars(int(at_state.step), eval_auc=ev["auc"],
+                   eval_loss=ev["loss"])
 
-    try:
+    def _tb_health(step: int) -> None:
+        tb.scalars(step, **{f"health/{name}": float(v)
+                            for name, v in train_health.snapshot().items()})
+
+    def _log_train_health(where: str) -> None:
+        if train_health.consume_dirty():
+            ulog.info(f"train health ({where}): {train_health.summary()}")
+
+    def _maybe_poison(pipeline):
+        """Test seam: an armed NaN plan (utils.faults.set_nan_plan) wraps
+        the pipeline once; the plan is consumed on pickup, so a rollback
+        replay (or the next epoch) trains clean data."""
+        plan = faults_lib.take_nan_plan()
+        if plan is not None:
+            return faults_lib.BatchPoisoner(pipeline, **plan)
+        return pipeline
+
+    def _env_steps(name: str) -> int:
+        raw = os.environ.get(name, "").strip()
+        try:
+            return int(raw) if raw else 0
+        except ValueError:
+            raise ValueError(
+                f"{name} must be an integer step count, got {raw!r}"
+            ) from None
+
+    # Fault injection (drill hooks): DEEPFM_TPU_FAULT_AFTER_STEPS=N kills
+    # training after >= N optimizer steps, AFTER the checkpoint hook has
+    # run — a deterministic spot-kill for exercising the crash-resume path
+    # end-to-end (the reference had no fault injection; SURVEY.md §5).
+    # PREEMPT_AFTER pulls the injectable preemption trigger instead (the
+    # graceful path: force-save + exit 42); PREEMPT_HOLD writes a sentinel
+    # file and blocks until a real signal arrives — scripts/preempt_drill.py
+    # uses it to SIGTERM a live run at a deterministic step. Every rank
+    # reads the same env via the launcher, so each fault is cluster-wide
+    # like a real slice preemption.
+    fault_after = _env_steps("DEEPFM_TPU_FAULT_AFTER_STEPS")
+    preempt_after = _env_steps("DEEPFM_TPU_PREEMPT_AFTER_STEPS")
+    hold_after = _env_steps("DEEPFM_TPU_PREEMPT_HOLD_AFTER_STEPS")
+
+    def _attempt(state: TrainState) -> TrainState:
+        """One full training attempt: resume-position computation, hook
+        stack, train loops, final forced save. A RollbackSignal (guard
+        policy ``rollback``) aborts the attempt; the driver loop below
+        restores the latest checkpoint and calls back in — the fresh
+        ``_resume_for`` then replays from that checkpoint's recorded
+        offset."""
+        restored_step = int(state.step)
+        epoch_base, start_epoch, skip_batches = _resume_for(restored_step)
+
+        # Data-pipeline position for the resume sidecar; epoch_start is the
+        # global step at which the current epoch's batch 0 was (or would
+        # have been) trained, so steps_into_epoch == batches consumed this
+        # epoch.
+        progress = {"epoch": start_epoch,
+                    "epoch_start": restored_step - skip_batches}
+
+        def _meta(step: int, completed: bool) -> Dict:
+            return {"step": step, "epoch": progress["epoch"],
+                    "steps_into_epoch": step - progress["epoch_start"],
+                    "epoch_base": epoch_base, "num_epochs": cfg.num_epochs,
+                    "pipe_mode": int(cfg.pipe_mode),
+                    "layout": _consumption_layout(cfg),
+                    "files": files_digest, "completed": completed}
+
         hooks = []
+        # Host-side step counter: reading s.step would force a device sync
+        # every step (it blocks on the async-dispatched update), collapsing
+        # throughput — one sync at restore time instead. First hook, so
+        # every later hook reads the post-dispatch count.
+        step_counter = [restored_step]
+        hooks.append(lambda s, m: step_counter.__setitem__(
+            0, step_counter[0] + int(m.get("steps_done", 1))))
+
         last_saved = [-1]
         if mgr is not None:
-            # Host-side step counter: reading s.step would force a device
-            # sync every step (it blocks on the async-dispatched update),
-            # collapsing throughput — one sync at restore time instead.
-            step_counter = [restored_step]
-
             def ckpt_hook(s: TrainState, m) -> None:
-                step_counter[0] += int(m.get("steps_done", 1))
                 if mgr.should_save(step_counter[0]):
                     if mgr.save(step_counter[0], s):
                         last_saved[0] = step_counter[0]
@@ -667,28 +753,71 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                             cfg.model_dir, _meta(step_counter[0], False))
             hooks.append(ckpt_hook)
 
-        # Fault injection (preemption drill): DEEPFM_TPU_FAULT_AFTER_STEPS=N
-        # kills training after >= N optimizer steps, AFTER the checkpoint
-        # hook has run — a deterministic spot-kill for exercising the
-        # resume path end-to-end (the reference had no fault injection;
-        # SURVEY.md §5). Every rank reads the same env via the launcher, so
-        # the crash is cluster-wide like a real slice preemption.
-        fault_raw = os.environ.get("DEEPFM_TPU_FAULT_AFTER_STEPS", "").strip()
-        try:
-            fault_after = int(fault_raw) if fault_raw else 0
-        except ValueError:
-            raise ValueError(
-                f"DEEPFM_TPU_FAULT_AFTER_STEPS must be an integer step "
-                f"count, got {fault_raw!r}") from None
-        if fault_after:
-            fault_count = [0]
+        if preempt_after:
+            def trigger_hook(s: TrainState, m) -> None:
+                if step_counter[0] - restored_step >= preempt_after:
+                    listener.trigger(
+                        f"env trigger after "
+                        f"{step_counter[0] - restored_step} steps")
+            hooks.append(trigger_hook)
 
+        if hold_after:
+            held = [False]
+
+            def hold_hook(s: TrainState, m) -> None:
+                if held[0] or step_counter[0] - restored_step < hold_after:
+                    return
+                held[0] = True
+                sentinel = fileio.join(cfg.model_dir or ".", ".preempt_hold")
+                with fileio.open_stream(sentinel, "w") as f:
+                    f.write(str(step_counter[0]))
+                deadline = time.time() + 120.0
+                while not listener.triggered():
+                    if time.time() > deadline:
+                        raise RuntimeError(
+                            "preempt hold: no signal arrived within 120s")
+                    time.sleep(0.05)
+            hooks.append(hold_hook)
+
+        # Preemption poll: once per dispatch single-process; multi-process
+        # ranks consult their local flag only at the agreed _eval_check_due
+        # dispatches and OR it across ranks, so every rank checkpoints and
+        # raises at the SAME dispatch — the lockstep collectives stay
+        # aligned (same pattern as the throttled-eval clock checks).
+        pc_dispatch = [0]
+
+        def preempt_hook(s: TrainState, m) -> None:
+            pc_dispatch[0] += 1
+            trig = listener.triggered()
+            if jax.process_count() > 1:
+                if not _eval_check_due(pc_dispatch[0]):
+                    return
+                from jax.experimental import multihost_utils  # noqa: PLC0415
+                trig = bool(np.asarray(multihost_utils.process_allgather(
+                    np.asarray([trig]))).any())
+            if not trig:
+                return
+            step = step_counter[0]
+            train_health.record_preemption()
+            ulog.warning(
+                f"preemption ({listener.reason or 'peer rank'}): force-"
+                f"saving checkpoint at step {step}, then exiting with code "
+                f"{preempt_lib.EXIT_PREEMPTED}")
+            if mgr is not None:
+                # An interval save may have just landed on this exact step
+                # (mgr.save dedups); the resume sidecar makes the mid-epoch
+                # position replay-exact on restart.
+                mgr.save(step, s, force=True)
+                _write_resume_meta(cfg.model_dir, _meta(step, False))
+            raise preempt_lib.Preempted(step, listener.reason)
+        hooks.append(preempt_hook)
+
+        if fault_after:
             def fault_hook(s: TrainState, m) -> None:
-                fault_count[0] += int(m.get("steps_done", 1))
-                if fault_count[0] >= fault_after:
+                if step_counter[0] - restored_step >= fault_after:
                     raise RuntimeError(
                         f"fault injection: simulated preemption after "
-                        f"{fault_count[0]} steps")
+                        f"{step_counter[0] - restored_step} steps")
             hooks.append(fault_hook)
 
         tracer = prof_lib.StepWindowTracer(
@@ -706,12 +835,13 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                 # FIFO not reusable per epoch). Eval afterwards, file-mode.
                 # Resume: the already-trained stream prefix is skipped
                 # (epoch index stays 0 — position is steps into the stream).
-                pipeline = make_streaming_pipeline(
+                pipeline = _maybe_poison(make_streaming_pipeline(
                     cfg, tr_files, epochs=cfg.num_epochs,
-                    skip_batches=skip_batches, epoch_offset=epoch_base)
+                    skip_batches=skip_batches, epoch_offset=epoch_base))
                 state, fit_m = trainer.fit(state, pipeline, hooks=hooks,
-                                           on_log=_tb_log)
+                                           on_log=_tb_log, guard=guard)
                 _log_health(pipeline, "stream end")
+                _log_train_health("stream end")
                 if fit_m["steps"]:
                     result["loss"] = fit_m["loss"]
                     result["examples_per_sec"] = fit_m.get(
@@ -723,7 +853,7 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                     result.update({"auc": ev["auc"], "eval_loss": ev["loss"],
                                    "eval_examples_per_sec":
                                        ev["examples_per_sec"]})
-                    _tb_eval(ev)
+                    _tb_eval(ev, state)
             else:
                 for epoch in range(start_epoch, cfg.num_epochs):
                     # Per-epoch loop in the driver, per the reference's
@@ -734,18 +864,18 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                     # which is also what makes mid-epoch resume exact: the
                     # resumed epoch replays the identical permutation and
                     # skips the already-trained prefix.
-                    if mgr is not None:
-                        progress["epoch"] = epoch
-                        progress["epoch_start"] = step_counter[0] - (
-                            skip_batches if epoch == start_epoch else 0)
-                    pipeline = make_pipeline(
+                    progress["epoch"] = epoch
+                    progress["epoch_start"] = step_counter[0] - (
+                        skip_batches if epoch == start_epoch else 0)
+                    pipeline = _maybe_poison(make_pipeline(
                         cfg, tr_files, epochs=1, shuffle=True,
                         epoch_offset=epoch_base + epoch,
                         skip_batches=(skip_batches if epoch == start_epoch
-                                      else 0))
+                                      else 0)))
                     state, fit_m = _fit_epoch(trainer, cfg, state, pipeline,
-                                              hooks, _tb_log)
+                                              hooks, _tb_log, guard=guard)
                     _log_health(pipeline, f"epoch {epoch + 1} end")
+                    _log_train_health(f"epoch {epoch + 1}")
                     if fit_m["steps"]:
                         # (a fully-skipped resumed epoch reports no loss)
                         result["loss"] = fit_m["loss"]
@@ -769,7 +899,7 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                         result.update({"auc": ev["auc"], "eval_loss": ev["loss"],
                                        "eval_examples_per_sec":
                                            ev["examples_per_sec"]})
-                        _tb_eval(ev)
+                        _tb_eval(ev, state)
                 if va_files and eval_throttled:
                     # Final eval at completion (train_and_evaluate does one).
                     ev = _run_eval(state, "final eval")
@@ -778,15 +908,42 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                     result.update({"auc": ev["auc"], "eval_loss": ev["loss"],
                                    "eval_examples_per_sec":
                                        ev["examples_per_sec"]})
-                    _tb_eval(ev)
+                    _tb_eval(ev, state)
         finally:
             tracer.close()
-            tb.close()
         if mgr is not None:
             final_step = int(state.step)
             mgr.save(final_step, state, force=True)
             _write_resume_meta(cfg.model_dir, _meta(final_step, True))
+        return state
+
+    try:
+        while True:
+            try:
+                state = _attempt(state)
+                break
+            except guard_lib.RollbackSignal as rs:
+                # on_nonfinite=rollback: restore the latest checkpoint and
+                # replay from its recorded offset. The guard's shared event
+                # budget (max_rollbacks, spanning skips AND rollbacks)
+                # already bounded how often we can get here — a run whose
+                # data keeps poisoning the same step exhausts it and aborts.
+                if mgr is None or mgr.latest_step() is None:
+                    raise guard_lib.NonFiniteError(
+                        f"rollback requested at step {rs.step} but no "
+                        f"checkpoint exists to roll back to (set model_dir "
+                        f"or use on_nonfinite=skip)") from rs
+                train_health.record_rollback()
+                mgr.wait()  # an async interval save may still be landing
+                state = mgr.restore(trainer.init_state())
+                ulog.warning(
+                    f"rolled back: restored checkpoint step "
+                    f"{int(state.step)} after non-finite at step {rs.step}; "
+                    f"replaying from the recorded offset")
+        _log_train_health("run end")
+        _tb_health(int(state.step))
     finally:
+        tb.close()
         if mgr is not None:
             mgr.close()
 
@@ -796,6 +953,8 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
     result["steps"] = float(int(state.step))
     result["read_retries"] = float(health_totals.get("read_retries", 0))
     result["bad_records"] = float(health_totals.get("bad_records", 0))
+    for name, v in train_health.snapshot().items():
+        result[name] = float(v)
     return result
 
 

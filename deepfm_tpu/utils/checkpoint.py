@@ -19,6 +19,7 @@ import orbax.checkpoint as ocp
 
 from ..data import fileio
 from . import logging as ulog
+from . import retry as retry_lib
 
 
 class CheckpointManager:
@@ -26,7 +27,8 @@ class CheckpointManager:
 
     def __init__(self, directory: str, *, max_to_keep: int = 3,
                  save_interval_steps: int = 0, async_save: bool = True,
-                 max_save_failures: int = 3):
+                 max_save_failures: int = 3,
+                 retry_policy: Optional[retry_lib.RetryPolicy] = None):
         self._dir = fileio.normalize_dir(directory)
         fileio.makedirs(self._dir)
         options = ocp.CheckpointManagerOptions(
@@ -44,13 +46,23 @@ class CheckpointManager:
         self.max_save_failures = max_save_failures
         self.save_failures = 0          # total failed save attempts
         self._consecutive_failures = 0
+        # Read-side hardening: retry/backoff around latest_step/restore — a
+        # transient storage error on restore would otherwise kill a resuming
+        # job instantly (the save side has been hardened since PR 1).
+        self._retry = retry_policy
+
+    def _call_read(self, fn, *args, op_name: str):
+        if self._retry is None:
+            return fn(*args)
+        return self._retry.call(fn, *args, op_name=op_name)
 
     @property
     def directory(self) -> str:
         return self._dir
 
     def latest_step(self) -> Optional[int]:
-        return self._mgr.latest_step()
+        return self._call_read(self._mgr.latest_step,
+                               op_name=f"latest_step({self._dir})")
 
     def _do_save(self, step: int, state: Any, force: bool) -> bool:
         """The actual Orbax write. Seam for fault injection (FlakyFS
@@ -104,8 +116,13 @@ class CheckpointManager:
             raise FileNotFoundError(f"no checkpoint found in {self._dir}")
         abstract = jax.tree.map(_as_abstract, state_template)
         try:
-            restored = self._mgr.restore(
-                step, args=ocp.args.StandardRestore(abstract))
+            # Retry-wrapped: a transient read fault heals; a ValueError
+            # (shape mismatch) is not retryable and falls through to the
+            # guidance below unchanged.
+            restored = self._call_read(
+                lambda: self._mgr.restore(
+                    step, args=ocp.args.StandardRestore(abstract)),
+                op_name=f"restore(step {step}, {self._dir})")
         except ValueError as e:
             if "not compatible with the stored shape" in str(e):
                 raise RuntimeError(
@@ -146,6 +163,18 @@ class CheckpointManager:
         return self
 
     def __exit__(self, *exc) -> None:
+        if exc and exc[0] is not None:
+            # Exiting on an exception (e.g. a preemption unwinding) with an
+            # async save possibly in flight: drain it so the checkpoint
+            # directory is never left half-written, but swallow secondary
+            # close errors — the original exception must propagate.
+            try:
+                self.close()
+            except Exception as close_exc:
+                ulog.warning(
+                    f"checkpoint close during exception unwind failed "
+                    f"(original error propagates): {close_exc}")
+            return
         self.close()
 
 

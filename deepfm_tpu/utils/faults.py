@@ -28,6 +28,70 @@ class InjectedFault(IOError):
     An IOError, so the default retryable classification applies."""
 
 
+# -- numerical-fault seam (NaN batches) ---------------------------------
+#
+# One-shot registry consumed by the train task: a test (or drill) arms a
+# plan with set_nan_plan(); _task_train takes it and wraps its pipeline in
+# a BatchPoisoner. Registry + wrapper live here (not in the data layer)
+# because poisoned batches are a FAULT, scripted and deterministic like
+# every other plan in this module — production pipelines never import it.
+
+_nan_plan_lock = threading.Lock()
+_nan_plan: Optional[Dict] = None
+
+
+def set_nan_plan(batches: Iterable[int], *, value: float = float("nan"),
+                 key: str = "feat_vals") -> None:
+    """Arm a one-shot plan: poison these 0-based batch indices of the NEXT
+    pipeline the train task builds (taken once, then cleared)."""
+    global _nan_plan
+    with _nan_plan_lock:
+        _nan_plan = dict(batches=tuple(int(b) for b in batches),
+                         value=float(value), key=str(key))
+
+
+def take_nan_plan() -> Optional[Dict]:
+    """Consume the armed plan (None when nothing is armed)."""
+    global _nan_plan
+    with _nan_plan_lock:
+        plan, _nan_plan = _nan_plan, None
+        return plan
+
+
+class BatchPoisoner:
+    """Pipeline wrapper that overwrites ``key`` of the planned batch
+    indices with ``value`` (NaN by default).
+
+    Deliberately exposes ONLY ``__iter__`` and ``health`` — hiding
+    ``iter_superbatches``/``decoded_cache`` forces the generic staged path
+    (device-resident and zero-copy feeds bypass per-batch host hooks, so a
+    poisoned run always goes through the one code path where the poison is
+    visible). Batch indices count per wrapper lifetime, across epochs of
+    the wrapped pipeline."""
+
+    def __init__(self, pipeline, *, batches: Tuple[int, ...],
+                 value: float = float("nan"), key: str = "feat_vals"):
+        self._pipeline = pipeline
+        self._batches = frozenset(int(b) for b in batches)
+        self._value = value
+        self._key = key
+        self.poisoned = 0
+
+    @property
+    def health(self):
+        return getattr(self._pipeline, "health", None)
+
+    def __iter__(self):
+        for i, batch in enumerate(self._pipeline):
+            if i in self._batches:
+                batch = dict(batch)
+                arr = batch[self._key].copy()
+                arr[...] = self._value
+                batch[self._key] = arr
+                self.poisoned += 1
+            yield batch
+
+
 class FlakyStream(io.RawIOBase):
     """Read-stream wrapper raising scripted faults; otherwise transparent."""
 

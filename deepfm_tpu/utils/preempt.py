@@ -1,0 +1,143 @@
+"""Preemption handling: signal listener + graceful-exit contract.
+
+The reference's preemption story was entirely implicit: SageMaker spot
+interruptions killed the container and the relaunched job resumed from the
+latest checkpoint in the shared ``model_dir`` (``1-ps-cpu/...py:434-435``),
+losing up to ``save_checkpoints_steps`` of work. Here the trainer *notices*
+the preemption: a :class:`PreemptionListener` converts SIGTERM/SIGINT into a
+flag that the fit loop polls once per dispatch; on trigger the in-flight
+dispatch finishes, a checkpoint + resume-meta sidecar are force-saved (so
+mid-epoch resume is replay-exact), and the process exits with
+:data:`EXIT_PREEMPTED` — a distinct code an orchestrator
+(``scripts/supervise.py``) uses to tell "preempted, restart me" from
+"crashed, give up".
+
+The listener also exposes :meth:`PreemptionListener.trigger` — an injectable
+trigger so tests and drills exercise the exact production code path without
+delivering real signals.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Optional, Tuple
+
+from . import logging as ulog
+
+# Exit-code contract (documented in README "Preemption & self-healing"):
+#   42 — preempted: a checkpoint + resume meta were saved; restart to resume.
+#   43 — watchdog abort: no dispatch progress within --dispatch_timeout_s;
+#        a restart MAY clear a transient stall (hung peer, wedged worker).
+# Anything else is an ordinary crash an orchestrator should not blindly retry.
+EXIT_PREEMPTED = 42
+EXIT_WATCHDOG = 43
+RESTARTABLE_EXIT_CODES = frozenset({EXIT_PREEMPTED, EXIT_WATCHDOG})
+
+
+class Preempted(Exception):
+    """Raised by the train task after the preemption checkpoint landed.
+
+    Carries the global step of the saved checkpoint; the launcher maps this
+    to :data:`EXIT_PREEMPTED`.
+    """
+
+    def __init__(self, step: int, reason: str = ""):
+        msg = f"preempted at step {step}"
+        if reason:
+            msg += f" ({reason})"
+        super().__init__(msg)
+        self.step = int(step)
+        self.reason = reason
+
+
+class PreemptionListener:
+    """SIGTERM/SIGINT -> flag, polled by the training loop.
+
+    Signal handlers can only be installed from the main thread; elsewhere
+    (e.g. a test driving ``tasks.run`` on a worker thread) the listener
+    degrades to trigger-only mode — :meth:`trigger` remains the injectable
+    test seam either way. ``install``/``uninstall`` save and restore the
+    prior handlers, so nesting inside pytest or another framework's handler
+    stack is safe.
+    """
+
+    def __init__(self, signals: Tuple[int, ...] = (signal.SIGTERM,
+                                                   signal.SIGINT)):
+        self._signals = tuple(signals)
+        self._event = threading.Event()
+        self._prev: dict = {}
+        self._installed = False
+        self.reason = ""
+
+    # -- trigger paths --------------------------------------------------
+    def _on_signal(self, signum, frame) -> None:  # noqa: ARG002
+        # Async-signal context: set the flag and nothing else; the training
+        # loop does the logging/saving at the next dispatch boundary.
+        self.reason = f"signal {signum}"
+        self._event.set()
+
+    def trigger(self, reason: str = "injected") -> None:
+        """Injectable trigger: same flag the signal handler sets."""
+        self.reason = reason
+        self._event.set()
+
+    def triggered(self) -> bool:
+        return self._event.is_set()
+
+    def clear(self) -> None:
+        """Reset the flag (tests reuse one process across drill phases)."""
+        self.reason = ""
+        self._event.clear()
+
+    # -- handler lifecycle ----------------------------------------------
+    def install(self) -> "PreemptionListener":
+        if self._installed:
+            return self
+        self._installed = True
+        if threading.current_thread() is not threading.main_thread():
+            ulog.info("preemption listener on a non-main thread: "
+                      "trigger-only mode (no signal handlers)")
+            return self
+        for sig in self._signals:
+            try:
+                self._prev[sig] = signal.signal(sig, self._on_signal)
+            except (ValueError, OSError):  # non-main thread race / exotic sig
+                pass
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        self._installed = False
+        for sig, prev in self._prev.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, OSError):
+                pass
+        self._prev.clear()
+
+    def __enter__(self) -> "PreemptionListener":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+
+_LISTENER: Optional[PreemptionListener] = None
+_LISTENER_LOCK = threading.Lock()
+
+
+def get_listener() -> PreemptionListener:
+    """Process-wide listener, installed on first use.
+
+    A flag set BEFORE training starts is honored at the first dispatch
+    (save-and-exit promptly) — a preemption notice during startup must not
+    be lost. Tests that trigger injection therefore ``clear()`` between
+    phases.
+    """
+    global _LISTENER
+    with _LISTENER_LOCK:
+        if _LISTENER is None:
+            _LISTENER = PreemptionListener()
+        return _LISTENER.install()

@@ -190,6 +190,34 @@ def run_drill(workdir, *, read_fail_every=7, verbose=True):
     say(f"checkpoint drill done: 1 save fault tolerated, resumed "
         f"{int(res_hard['steps'])} -> {int(res_resume['steps'])} steps")
 
+    # 4. Same faulty input through the decoded-epoch cache and the
+    # device-resident fit: the healed/skipped record stream feeds the cache
+    # build instead of the per-batch decode, and the final params must STILL
+    # be bit-identical to the clean staged baseline — fault tolerance holds
+    # across every input path, not just the one it was written against.
+    from deepfm_tpu.data import cache as cache_lib
+    for label, extra in (("decoded_cache=ram", dict(decoded_cache="ram")),
+                         ("device_dataset", dict(decoded_cache="ram",
+                                                 device_dataset=True))):
+        ckpt = os.path.join(workdir, f"ckpt_{label.split('=')[0]}")
+        cfg_path = _cfg(faulty_dir, ckpt, on_bad_record="skip",
+                        max_bad_records=1, **extra)
+        # Drop the process-global RAM epoch cache so this run re-decodes
+        # through the injected-fault filesystem instead of hitting the
+        # previous label's cached columns.
+        cache_lib.clear_ram_cache()
+        with faults.FlakyFS(read_fail_every=read_fail_every) as fs_p:
+            res_path = tasks.run(cfg_path)
+        assert fs_p.injected_read_faults > 0, f"{label}: nothing injected"
+        params_path, step_path = final_params(cfg_path)
+        assert step_path == step_clean, (
+            f"{label}: step count diverged: {step_path} vs {step_clean}")
+        assert_tree_equal(params_clean, params_path,
+                          f"clean-vs-faulty final params ({label})")
+        say(f"{label} run done: params bit-identical to clean "
+            f"({fs_p.injected_read_faults} read faults healed, "
+            f"{int(res_path['bad_records'])} records skipped)")
+
     return {
         "steps": step_clean,
         "read_faults_injected": fs_read_faults(res_faulty),

@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""Preemption drill: SIGTERM a live run mid-epoch, resume, assert parity.
+
+The executable acceptance check for the preemption-safe runtime
+(``deepfm_tpu/utils/preempt.py`` + the train-task preemption hook +
+``scripts/supervise.py``), per path:
+
+  1. **Baseline.** An uninterrupted run -> final params.
+  2. **Kill.** Launch the same run as a real ``deepfm_tpu.launch``
+     subprocess with ``DEEPFM_TPU_PREEMPT_HOLD_AFTER_STEPS=N``: after N
+     optimizer steps it writes a ``.preempt_hold`` sentinel into model_dir
+     and blocks awaiting a signal. The drill SIGTERMs it there —
+     a genuine asynchronous preemption mid-epoch — and asserts the
+     process force-saved and exited with code 42 (EXIT_PREEMPTED).
+  3. **Supervised resume.** Restart through
+     ``supervise.run_supervised``, with the relaunches themselves
+     preempted every few steps (``DEEPFM_TPU_PREEMPT_AFTER_STEPS``), so
+     the supervisor's restart loop is exercised by real exit-42 children
+     until the run completes.
+  4. **Parity.** Final params must be bit-identical to the baseline —
+     the checkpoint + resume-sidecar replay is exact, not approximate.
+
+Runs on the staged host-input path and again on the single-chip
+device-resident path (``--decoded_cache ram --device_dataset 1``; the
+resumed mid-epoch segment falls back to staged by design — the skip-offset
+replay owns the trained-prefix drop — which is exactly the cross-path
+bit-identity worth drilling).
+
+Run on CPU:  JAX_PLATFORMS=cpu python scripts/preempt_drill.py
+"""
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepfm_tpu.config import Config
+from deepfm_tpu.data import libsvm
+from deepfm_tpu.train import tasks
+from deepfm_tpu.utils import preempt as preempt_lib
+
+from fault_drill import assert_tree_equal, final_params
+from supervise import run_supervised
+
+FEATURE_SIZE = 64
+FIELD_SIZE = 5
+NUM_FILES = 2
+RECORDS_PER_FILE = 48
+HOLD_AFTER_STEPS = 3     # SIGTERM point: mid-epoch (6 steps/epoch)
+RESUME_PREEMPT_EVERY = 4  # supervised relaunches re-preempt this often
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _flags(data_dir, model_dir, **kw):
+    base = dict(
+        task_type="train", data_dir=data_dir, model_dir=model_dir,
+        feature_size=FEATURE_SIZE, field_size=FIELD_SIZE, embedding_size=4,
+        deep_layers="8", dropout="1.0", batch_size=16, num_epochs=2,
+        compute_dtype="float32", mesh_data=1, log_steps=0,
+        scale_lr_by_world=False, seed=17, verify_crc=True,
+        save_checkpoints_steps=0)
+    base.update(kw)
+    return base
+
+
+def _cfg(data_dir, model_dir, **kw):
+    return Config(**_flags(data_dir, model_dir, **kw))
+
+
+def _cmd(flags):
+    argv = [sys.executable, "-m", "deepfm_tpu.launch"]
+    for name, value in flags.items():
+        argv += [f"--{name}", str(int(value) if isinstance(value, bool)
+                                  else value)]
+    return argv
+
+
+def _env(**extra):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=_REPO_ROOT + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    env.pop("DEEPFM_TPU_PREEMPT_HOLD_AFTER_STEPS", None)
+    env.pop("DEEPFM_TPU_PREEMPT_AFTER_STEPS", None)
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def _drill_path(workdir, data_dir, *, label, extra_flags, verbose=True):
+    def say(msg):
+        if verbose:
+            print(f"[preempt_drill:{label}] {msg}")
+
+    # 1. Uninterrupted baseline.
+    base_ckpt = os.path.join(workdir, f"ckpt_base_{label}")
+    tasks.run(_cfg(data_dir, base_ckpt, **extra_flags))
+    params_base, step_base = final_params(_cfg(data_dir, base_ckpt))
+    say(f"baseline done: {step_base} steps")
+
+    # 2. Kill a live subprocess mid-epoch: it holds at the sentinel, we
+    # SIGTERM it there, it must force-save and exit 42.
+    pre_ckpt = os.path.join(workdir, f"ckpt_pre_{label}")
+    flags = _flags(data_dir, pre_ckpt, **extra_flags)
+    sentinel = os.path.join(pre_ckpt, ".preempt_hold")
+    proc = subprocess.Popen(
+        _cmd(flags), cwd=_REPO_ROOT,
+        env=_env(DEEPFM_TPU_PREEMPT_HOLD_AFTER_STEPS=HOLD_AFTER_STEPS))
+    deadline = time.time() + 300.0
+    while not os.path.exists(sentinel):
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"run exited (code {proc.returncode}) before the hold point")
+        if time.time() > deadline:
+            proc.kill()
+            raise AssertionError("timed out waiting for the hold sentinel")
+        time.sleep(0.05)
+    proc.send_signal(signal.SIGTERM)
+    rc = proc.wait(timeout=300)
+    assert rc == preempt_lib.EXIT_PREEMPTED, (
+        f"preempted run exited {rc}, expected {preempt_lib.EXIT_PREEMPTED}")
+    say(f"SIGTERM at step >= {HOLD_AFTER_STEPS}: exit code {rc}, "
+        f"checkpoint + sidecar saved")
+
+    # 3. Supervised resume, itself re-preempted every few steps so the
+    # supervisor loop restarts real exit-42 children until completion.
+    restarts = []
+    rc = run_supervised(
+        _cmd(flags), max_restarts=10, backoff_secs=0.0,
+        spawn=lambda c: subprocess.call(
+            c, cwd=_REPO_ROOT,
+            env=_env(DEEPFM_TPU_PREEMPT_AFTER_STEPS=RESUME_PREEMPT_EVERY)),
+        log=lambda m: (restarts.append(m), say(m)))
+    assert rc == 0, f"supervised resume failed with exit code {rc}"
+    assert any("restart 1/" in m for m in restarts), (
+        "supervisor never restarted; the re-preempt trigger did not fire")
+
+    # 4. Bit-identity with the uninterrupted baseline.
+    params_pre, step_pre = final_params(_cfg(data_dir, pre_ckpt))
+    assert step_pre == step_base, (
+        f"step count diverged: {step_pre} vs {step_base}")
+    assert_tree_equal(params_base, params_pre,
+                      f"{label}: interrupted-vs-baseline final params")
+    say(f"resume complete: params bit-identical to baseline "
+        f"({len(restarts)} supervisor event(s))")
+
+
+def run_drill(workdir, verbose=True):
+    data_dir = os.path.join(workdir, "data")
+    libsvm.generate_synthetic_ctr(
+        data_dir, num_files=NUM_FILES, examples_per_file=RECORDS_PER_FILE,
+        feature_size=FEATURE_SIZE, field_size=FIELD_SIZE, prefix="tr",
+        seed=5)
+    _drill_path(workdir, data_dir, label="staged", extra_flags={},
+                verbose=verbose)
+    _drill_path(workdir, data_dir, label="device",
+                extra_flags=dict(decoded_cache="ram", device_dataset=True),
+                verbose=verbose)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--workdir", default="",
+                    help="scratch dir (default: a fresh TemporaryDirectory)")
+    args = ap.parse_args()
+    if args.workdir:
+        os.makedirs(args.workdir, exist_ok=True)
+        run_drill(args.workdir)
+    else:
+        with tempfile.TemporaryDirectory(prefix="preempt_drill_") as d:
+            run_drill(d)
+    print("[preempt_drill] PASS")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Relaunch-on-preemption supervisor for ``deepfm_tpu.launch`` runs.
+
+The orchestrator half of the preemption contract (see
+``deepfm_tpu/utils/preempt.py``): the training process exits with a
+RESTARTABLE exit code (42 = graceful preemption, 43 = stall-watchdog abort)
+after force-saving its checkpoint + resume sidecar; this wrapper relaunches
+it — checkpoint auto-resume makes the restart replay-exact — with a restart
+cap and exponential backoff so a crash-looping job cannot spin forever.
+Ordinary failures (any other nonzero code) are NOT retried: a code bug or a
+bad config should fail fast, not burn a reservation retrying.
+
+Usage:
+    python scripts/supervise.py [--max_restarts N] [--backoff_secs S] -- \
+        python -m deepfm_tpu.launch --task_type train ...
+
+Everything after ``--`` is the command to supervise.
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepfm_tpu.utils import preempt as preempt_lib
+
+
+def run_supervised(cmd, *, max_restarts=5, backoff_secs=1.0,
+                   sleep=time.sleep, spawn=None, log=print):
+    """Run ``cmd`` until it exits cleanly, restarting on preemption codes.
+
+    Returns the final exit code: 0 on success, the child's code on a
+    non-restartable failure, or the last restartable code when the restart
+    budget is exhausted. ``sleep``/``spawn`` are injectable for tests
+    (``spawn(cmd) -> int`` defaults to ``subprocess.call``).
+    """
+    spawn = spawn if spawn is not None else (lambda c: subprocess.call(c))
+    restarts = 0
+    while True:
+        rc = spawn(cmd)
+        if rc == 0:
+            if restarts:
+                log(f"[supervise] run completed after {restarts} restart(s)")
+            return 0
+        if rc not in preempt_lib.RESTARTABLE_EXIT_CODES:
+            log(f"[supervise] child failed with non-restartable exit code "
+                f"{rc}; giving up")
+            return rc
+        if restarts >= max_restarts:
+            log(f"[supervise] restart budget exhausted "
+                f"({restarts}/{max_restarts}); last exit code {rc}")
+            return rc
+        delay = backoff_secs * (2 ** restarts)
+        restarts += 1
+        log(f"[supervise] exit code {rc} "
+            f"({'preempted' if rc == preempt_lib.EXIT_PREEMPTED else 'stalled'}"
+            f"); restart {restarts}/{max_restarts} in {delay:g}s")
+        if delay > 0:
+            sleep(delay)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--max_restarts", type=int, default=5,
+                    help="restart budget for preemption exits (default 5)")
+    ap.add_argument("--backoff_secs", type=float, default=1.0,
+                    help="base backoff, doubled per restart (default 1.0)")
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="command to supervise (prefix with --)")
+    args = ap.parse_args()
+    cmd = args.cmd[1:] if args.cmd[:1] == ["--"] else args.cmd
+    if not cmd:
+        ap.error("no command given (put it after --)")
+    return run_supervised(cmd, max_restarts=args.max_restarts,
+                          backoff_secs=args.backoff_secs)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -53,6 +53,10 @@ def pytest_configure(config):
         "markers",
         "mp_collectives: requires cross-process collectives on the CPU "
         "backend (2+ jax processes); skipped when jaxlib lacks them")
+    config.addinivalue_line(
+        "markers",
+        "preempt: preemption/self-healing runtime tests (signal-driven "
+        "checkpointing, NaN guard policies, stall watchdogs, supervisor)")
 
 
 # ---------------------------------------------------------------------------

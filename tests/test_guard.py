@@ -1,0 +1,375 @@
+"""Numerical-guard and stall-watchdog tests: TrainHealth accounting,
+NonFiniteGuard policy semantics (abort/skip/rollback + shared budget +
+EMA loss-spike detector), fit-level skip bit-identity, watchdog firing
+with injected clock/abort, and the input-worker ring-read stall timeout.
+CPU-only; the watchdog tests use a fake clock (no real timeout sleeps)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deepfm_tpu.config import Config
+from deepfm_tpu.data import workers
+from deepfm_tpu.data.health import DataHealth
+from deepfm_tpu.train import Trainer, tasks
+from deepfm_tpu.train import guard as guard_lib
+
+pytestmark = pytest.mark.preempt
+
+NAN = float("nan")
+
+
+class TestTrainHealth:
+    def test_counters_and_snapshot(self):
+        th = guard_lib.TrainHealth()
+        th.record_preemption()
+        th.record_nonfinite_skip()
+        th.record_nonfinite_skip()
+        th.record_rollback()
+        th.record_watchdog_abort()
+        th.record_loss_spike()
+        th.record_resume_meta_corrupt()
+        snap = th.snapshot()
+        assert snap == {"preemptions": 1, "nonfinite_skips": 2,
+                        "rollbacks": 1, "watchdog_aborts": 1,
+                        "loss_spikes": 1, "resume_meta_corrupt": 1}
+        assert th.total_events == 7
+
+    def test_merge_into_and_summary(self):
+        th = guard_lib.TrainHealth()
+        th.record_rollback()
+        totals = {"rollbacks": 2.0}
+        th.merge_into(totals)
+        assert totals["rollbacks"] == 3.0
+        assert totals["preemptions"] == 0
+        assert "rollbacks=1" in th.summary()
+
+    def test_consume_dirty(self):
+        th = guard_lib.TrainHealth()
+        assert th.consume_dirty() is False
+        th.record_nonfinite_skip()
+        assert th.consume_dirty() is True
+        assert th.consume_dirty() is False  # one-shot until the next event
+
+    def test_thread_safety(self):
+        th = guard_lib.TrainHealth()
+        threads = [threading.Thread(
+            target=lambda: [th.record_nonfinite_skip() for _ in range(500)])
+            for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert th.nonfinite_skips == 2000
+
+
+class TestNonFiniteGuardUnits:
+    def test_invalid_policy_rejected(self):
+        with pytest.raises(ValueError, match="abort"):
+            guard_lib.NonFiniteGuard(policy="explode")
+
+    def test_ok_path(self):
+        g = guard_lib.NonFiniteGuard(policy="skip")
+        assert g.observe(0.5, 1) == "ok"
+        assert g.events == 0
+        assert g.per_dispatch is True
+
+    def test_abort_raises_with_step(self):
+        g = guard_lib.NonFiniteGuard(policy="abort")
+        assert g.per_dispatch is False
+        with pytest.raises(guard_lib.NonFiniteError, match="step 7"):
+            g.observe(NAN, 7)
+
+    def test_abort_on_bad_params_with_finite_loss(self):
+        g = guard_lib.NonFiniteGuard(policy="abort")
+        with pytest.raises(guard_lib.NonFiniteError,
+                           match="non-finite parameters"):
+            g.observe(0.3, 9, params_bad=True)
+
+    def test_skip_counts_and_budget(self):
+        th = guard_lib.TrainHealth()
+        g = guard_lib.NonFiniteGuard(policy="skip", max_events=2, health=th)
+        assert g.per_dispatch is True
+        assert g.observe(NAN, 1) == "skip"
+        assert g.observe(float("inf"), 2) == "skip"
+        assert th.nonfinite_skips == 2
+        with pytest.raises(guard_lib.NonFiniteError,
+                           match="budget exhausted"):
+            g.observe(NAN, 3)
+
+    def test_rollback_verdict_shares_budget(self):
+        g = guard_lib.NonFiniteGuard(policy="rollback", max_events=1)
+        assert g.observe(NAN, 4) == "rollback"
+        with pytest.raises(guard_lib.NonFiniteError, match="budget"):
+            g.observe(NAN, 5)
+
+    def test_from_config(self):
+        cfg = Config(data_dir="/tmp/x", on_nonfinite="rollback",
+                     max_rollbacks=7, loss_spike_zscore=4.0)
+        g = guard_lib.NonFiniteGuard.from_config(cfg)
+        assert g.policy == "rollback" and g.max_events == 7
+        assert g.spike_zscore == 4.0
+
+    def test_spike_detector(self):
+        th = guard_lib.TrainHealth()
+        g = guard_lib.NonFiniteGuard(policy="abort", health=th,
+                                     spike_zscore=4.0, spike_warmup=5)
+        for i in range(20):  # well-behaved losses (~1 sigma wiggle)
+            g.observe(0.7 + 0.01 * (-1) ** i, i)
+        assert th.loss_spikes == 0
+        ema_before = g._ema
+        g.observe(50.0, 21)  # a 100-sigma excursion, still finite
+        assert th.loss_spikes == 1
+        assert g._ema == ema_before  # a spike must not poison its baseline
+        g.observe(0.7, 22)
+        assert th.loss_spikes == 1  # back to normal: no new spike
+
+    def test_spike_detector_disabled_by_default(self):
+        th = guard_lib.TrainHealth()
+        g = guard_lib.NonFiniteGuard(policy="abort", health=th)
+        for i in range(30):
+            g.observe(0.5 if i != 25 else 1e6, i)
+        assert th.loss_spikes == 0
+
+    def test_params_nonfinite_detects(self):
+        class S:
+            params = {"w": np.ones(4, np.float32),
+                      "ids": np.arange(4, dtype=np.int32)}
+        g = guard_lib.NonFiniteGuard(policy="skip")
+        assert g.params_nonfinite(S()) is False
+        S.params = {"w": np.array([1.0, NAN], np.float32)}
+        assert g.params_nonfinite(S()) is True
+        # int leaves are exempt (isfinite is undefined on them)
+        S.params = {"ids": np.arange(4, dtype=np.int32)}
+        assert g.params_nonfinite(S()) is False
+
+
+def _cfg(**kw):
+    base = dict(
+        feature_size=50, field_size=4, embedding_size=4, deep_layers="8",
+        dropout="1.0", batch_size=8, compute_dtype="float32",
+        learning_rate=0.05, log_steps=0, seed=13, scale_lr_by_world=False,
+        mesh_data=1, mesh_model=1)
+    base.update(kw)
+    return Config(**base)
+
+
+def _batches(n, bs=8, fields=4, nan_at=()):
+    rng = np.random.default_rng(42)
+    out = []
+    for i in range(n):
+        b = {"feat_ids": rng.integers(0, 50, (bs, fields)).astype(np.int32),
+             "feat_vals": rng.normal(size=(bs, fields)).astype(np.float32),
+             "label": (rng.random((bs, 1)) < 0.3).astype(np.float32)}
+        if i in nan_at:
+            b["feat_vals"] = np.full((bs, fields), NAN, np.float32)
+        out.append(b)
+    return out
+
+
+def _params(state):
+    import jax
+    return jax.tree.map(np.asarray, state.params)
+
+
+def _assert_equal(a, b):
+    import jax
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+class TestFitGuardPolicies:
+    def test_skip_is_bit_identical_to_clean_run_without_poison(self):
+        # Guarded run over [b0, b1, NAN, b2, b3] must equal a clean run
+        # over [b0, b1, b2, b3]: the poisoned dispatch is consumed but its
+        # update (and its rng/step advance) never happened.
+        clean = _batches(4)
+        poisoned = clean[:2] + _batches(3, nan_at=(0,))[:1] + clean[2:]
+        cfg = _cfg(on_nonfinite="skip")
+
+        tr_clean = Trainer(cfg)
+        s_clean, sum_clean = tr_clean.fit(tr_clean.init_state(), clean)
+
+        th = guard_lib.TrainHealth()
+        guard = guard_lib.NonFiniteGuard.from_config(cfg, health=th)
+        tr = Trainer(cfg)
+        s_guard, sum_guard = tr.fit(tr.init_state(), poisoned, guard=guard)
+
+        assert sum_guard["steps"] == sum_clean["steps"] == 4
+        assert int(s_guard.step) == int(s_clean.step) == 4
+        assert th.nonfinite_skips == 1
+        _assert_equal(_params(s_clean), _params(s_guard))
+        np.testing.assert_array_equal(np.asarray(s_clean.rng),
+                                      np.asarray(s_guard.rng))
+
+    def test_skip_reports_finite_final_loss(self):
+        # The last dispatch is poisoned: the summary loss must come from
+        # the last ACCEPTED dispatch, not the dropped one.
+        cfg = _cfg(on_nonfinite="skip")
+        guard = guard_lib.NonFiniteGuard.from_config(cfg)
+        tr = Trainer(cfg)
+        _, summary = tr.fit(tr.init_state(), _batches(4, nan_at=(3,)),
+                            guard=guard)
+        assert summary["steps"] == 3
+        assert np.isfinite(summary["loss"])
+
+    def test_abort_raises_on_log_cadence(self):
+        cfg = _cfg(on_nonfinite="abort", log_steps=1)
+        guard = guard_lib.NonFiniteGuard.from_config(cfg)
+        tr = Trainer(cfg)
+        with pytest.raises(guard_lib.NonFiniteError, match="non-finite"):
+            tr.fit(tr.init_state(), _batches(4, nan_at=(1,)), guard=guard)
+
+    def test_rollback_raises_signal(self):
+        cfg = _cfg(on_nonfinite="rollback")
+        guard = guard_lib.NonFiniteGuard.from_config(cfg)
+        tr = Trainer(cfg)
+        with pytest.raises(guard_lib.RollbackSignal) as ei:
+            tr.fit(tr.init_state(), _batches(4, nan_at=(2,)), guard=guard)
+        assert ei.value.step == 3  # step AFTER the poisoned dispatch
+
+    def test_budget_exhaustion_aborts_mid_fit(self):
+        cfg = _cfg(on_nonfinite="skip", max_rollbacks=1)
+        guard = guard_lib.NonFiniteGuard.from_config(cfg)
+        tr = Trainer(cfg)
+        with pytest.raises(guard_lib.NonFiniteError, match="budget"):
+            tr.fit(tr.init_state(), _batches(6, nan_at=(1, 3)), guard=guard)
+
+    def test_skip_under_steps_per_loop_scan(self):
+        # A poisoned batch inside a k=2 scan group drops the WHOLE group's
+        # update (the scan is one dispatch); the clean groups still train.
+        cfg = _cfg(on_nonfinite="skip", steps_per_loop=2)
+        guard = guard_lib.NonFiniteGuard.from_config(cfg)
+        tr = Trainer(cfg)
+        state, summary = tr.fit(tr.init_state(), _batches(6, nan_at=(2,)),
+                                guard=guard)
+        assert summary["steps"] == 4  # groups (0,1) and (4,5) accepted
+        assert int(state.step) == 4
+        assert guard.health.nonfinite_skips == 1
+
+
+class TestStallWatchdog:
+    def _wait_for(self, pred, timeout=5.0):
+        deadline = time.time() + timeout
+        while not pred():
+            if time.time() > deadline:
+                raise AssertionError("watchdog condition never became true")
+            time.sleep(0.005)
+
+    def test_fires_with_diagnostic_dump(self):
+        t = [0.0]
+        fired = []
+        th = guard_lib.TrainHealth()
+        dh = DataHealth()
+        wd = guard_lib.StallWatchdog(
+            30.0, health=th, data_health=dh, abort=fired.append,
+            clock=lambda: t[0], poll_s=0.001)
+        with wd:
+            wd.beat(17)
+            t[0] = 31.0
+            self._wait_for(lambda: fired)
+        dump = fired[0]
+        assert "no dispatch completed" in dump
+        assert "step 17" in dump
+        assert "data health:" in dump and "train health:" in dump
+        assert th.watchdog_aborts == 1
+        assert wd.fired is True
+
+    def test_beats_keep_it_quiet(self):
+        t = [0.0]
+        fired = []
+        wd = guard_lib.StallWatchdog(10.0, abort=fired.append,
+                                     clock=lambda: t[0], poll_s=0.001)
+        with wd:
+            for i in range(5):
+                t[0] += 9.0  # always under the timeout since the last beat
+                wd.beat(i)
+                time.sleep(0.005)
+        assert not fired and wd.fired is False
+
+    def test_trainer_builds_watchdog_only_when_configured(self):
+        tr = Trainer(_cfg(dispatch_timeout_s=0.0))
+        assert tr._make_watchdog(None, None) is None
+        tr2 = Trainer(_cfg(dispatch_timeout_s=60.0))
+
+        def aborter(dump):
+            pass
+
+        tr2.watchdog_abort = aborter
+        wd = tr2._make_watchdog(None, None)
+        try:
+            assert wd is not None and wd._abort is aborter
+        finally:
+            wd.stop()
+
+    def test_fit_stall_aborts_via_injected_hook(self):
+        # Integration: a source that stops producing mid-run trips the
+        # watchdog, which calls the injected abort instead of os._exit.
+        cfg = _cfg(dispatch_timeout_s=0.15)
+        tr = Trainer(cfg)
+        fired = threading.Event()
+        dumps = []
+        tr.watchdog_abort = lambda d: (dumps.append(d), fired.set())
+
+        def stalling_source():
+            yield from _batches(2)
+            fired.wait(timeout=10.0)  # stall until the watchdog trips
+
+        state, summary = tr.fit(tr.init_state(), stalling_source())
+        assert fired.is_set(), "watchdog never fired on the stalled source"
+        assert summary["steps"] == 2
+        assert "no dispatch completed" in dumps[0]
+
+
+class TestInputStallTimeout:
+    class _EmptyRing:
+        def pop(self, timeout):
+            raise workers._queue.Empty
+
+    class _AliveProc:
+        def is_alive(self):
+            return True
+
+    class _DeadProc:
+        exitcode = 9
+
+        def is_alive(self):
+            return False
+
+    def _service(self, ring, proc, stall_timeout_s):
+        svc = workers.ShmInputService.__new__(workers.ShmInputService)
+        svc._rings = [ring]
+        svc._procs = [proc]
+        svc._poll_secs = 0.05  # accounting unit only: pop returns instantly
+        svc._stall_timeout_s = stall_timeout_s
+        svc.health = DataHealth()
+        return svc
+
+    def test_alive_but_silent_worker_raises_stall(self):
+        svc = self._service(self._EmptyRing(), self._AliveProc(), 0.2)
+        with pytest.raises(workers.InputStallError) as ei:
+            svc._pop(0)
+        msg = str(ei.value)
+        assert "worker 0" in msg and "stall_timeout_s" in msg
+        assert "data health" in msg
+
+    def test_zero_timeout_keeps_waiting(self):
+        # stall_timeout_s=0 (the default) must preserve the wait-forever
+        # behavior: a dead worker still surfaces as _WorkerDied, never as a
+        # stall.
+        ring = self._EmptyRing()
+        svc = self._service(ring, self._DeadProc(), 0.0)
+        with pytest.raises(workers._WorkerDied):
+            svc._pop(0)
+
+    def test_dead_worker_beats_stall_classification(self):
+        svc = self._service(self._EmptyRing(), self._DeadProc(), 10.0)
+        with pytest.raises(workers._WorkerDied):
+            svc._pop(0)
+
+    def test_pipeline_threads_timeout_to_service(self, tmp_path):
+        cfg = _cfg(data_dir=str(tmp_path), dispatch_timeout_s=2.5)
+        pipe = tasks.make_pipeline(cfg, ["tr_none.tfrecord"])
+        assert pipe.stall_timeout_s == 2.5

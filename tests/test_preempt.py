@@ -1,0 +1,448 @@
+"""Preemption-runtime tests: signal listener, exit-code contract, in-process
+preempt->resume bit-identity, guard policies at the task level (skip /
+rollback / abort via the NaN fault seam), tolerant resume sidecars,
+checkpoint read-side retries, exception-safe manager exit, TrainHealth
+counters in the result dict + TensorBoard, and the supervisor restart loop.
+CPU-only; zero-backoff retry policies (no real sleeps)."""
+
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from deepfm_tpu.config import Config
+from deepfm_tpu.data import libsvm
+from deepfm_tpu.train import Trainer, tasks
+from deepfm_tpu.train import guard as guard_lib
+from deepfm_tpu.utils import checkpoint as ckpt_lib
+from deepfm_tpu.utils import faults
+from deepfm_tpu.utils import preempt as preempt_lib
+from deepfm_tpu.utils import retry as retry_lib
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "scripts"))
+import supervise  # noqa: E402
+
+pytestmark = pytest.mark.preempt
+
+NO_SLEEP = retry_lib.RetryPolicy(base_delay=0.0, max_delay=0.0)
+
+FEATURE_SIZE = 64
+FIELD_SIZE = 5
+BATCHES_PER_EPOCH = 6  # 2 files x 48 records / batch_size 16
+
+
+class TestListener:
+    def test_trigger_and_clear(self):
+        lst = preempt_lib.PreemptionListener()
+        assert not lst.triggered()
+        lst.trigger("spot notice")
+        assert lst.triggered() and lst.reason == "spot notice"
+        lst.clear()
+        assert not lst.triggered() and lst.reason == ""
+
+    def test_real_signal_sets_flag(self):
+        lst = preempt_lib.PreemptionListener(signals=(signal.SIGTERM,))
+        with lst:
+            os.kill(os.getpid(), signal.SIGTERM)
+            deadline = time.time() + 5.0
+            while not lst.triggered() and time.time() < deadline:
+                time.sleep(0.01)
+            assert lst.triggered()
+            assert lst.reason == f"signal {int(signal.SIGTERM)}"
+
+    def test_uninstall_restores_prior_handler(self):
+        prior = signal.getsignal(signal.SIGTERM)
+        lst = preempt_lib.PreemptionListener(signals=(signal.SIGTERM,))
+        lst.install()
+        assert signal.getsignal(signal.SIGTERM) != prior
+        lst.uninstall()
+        assert signal.getsignal(signal.SIGTERM) == prior
+
+    def test_exit_code_contract(self):
+        assert preempt_lib.EXIT_PREEMPTED == 42
+        assert preempt_lib.EXIT_WATCHDOG == 43
+        assert preempt_lib.RESTARTABLE_EXIT_CODES == {42, 43}
+        # 0 (done) and 1 (crash) must never be restartable
+        assert 0 not in preempt_lib.RESTARTABLE_EXIT_CODES
+        assert 1 not in preempt_lib.RESTARTABLE_EXIT_CODES
+
+
+class TestSupervisor:
+    def _run(self, codes, **kw):
+        seq = list(codes)
+        sleeps = []
+        rc = supervise.run_supervised(
+            ["train"], spawn=lambda cmd: seq.pop(0),
+            sleep=sleeps.append, log=lambda m: None, **kw)
+        return rc, sleeps, seq
+
+    def test_clean_exit_passes_through(self):
+        rc, sleeps, _ = self._run([0])
+        assert rc == 0 and sleeps == []
+
+    def test_preemption_restarts_with_backoff(self):
+        rc, sleeps, left = self._run([42, 43, 0], backoff_secs=1.0)
+        assert rc == 0 and left == []
+        assert sleeps == [1.0, 2.0]  # exponential per restart
+
+    def test_ordinary_crash_not_retried(self):
+        rc, sleeps, left = self._run([1, 0])
+        assert rc == 1 and sleeps == [] and left == [0]
+
+    def test_restart_budget_exhausted(self):
+        rc, sleeps, _ = self._run([42] * 10, max_restarts=2,
+                                  backoff_secs=0.5)
+        assert rc == 42
+        assert sleeps == [0.5, 1.0]  # two restarts, then give up
+
+
+def _state(step=0):
+    return {"w": np.arange(8, dtype=np.float32) + step,
+            "b": np.full((1,), step, dtype=np.float32)}
+
+
+class TestCheckpointReadRetries:
+    def _mgr(self, tmp_path, **kw):
+        return ckpt_lib.CheckpointManager(
+            str(tmp_path / "c"), async_save=False,
+            retry_policy=NO_SLEEP, **kw)
+
+    def test_latest_step_heals_transient_fault(self, tmp_path):
+        mgr = self._mgr(tmp_path)
+        try:
+            mgr.save(3, _state(3))
+            original = mgr._mgr.latest_step
+            calls = []
+
+            def flaky():
+                calls.append(1)
+                if len(calls) == 1:
+                    raise IOError("transient storage error")
+                return original()
+
+            mgr._mgr.latest_step = flaky
+            try:
+                assert mgr.latest_step() == 3
+            finally:
+                mgr._mgr.latest_step = original
+            assert len(calls) == 2
+        finally:
+            mgr.close()
+
+    def test_restore_heals_transient_fault(self, tmp_path):
+        mgr = self._mgr(tmp_path)
+        try:
+            mgr.save(5, _state(5))
+            original = mgr._mgr.restore
+            calls = []
+
+            def flaky(step, args=None):
+                calls.append(1)
+                if len(calls) == 1:
+                    raise IOError("transient storage error")
+                return original(step, args=args)
+
+            mgr._mgr.restore = flaky
+            try:
+                restored = mgr.restore(_state())
+            finally:
+                mgr._mgr.restore = original
+            assert len(calls) == 2
+            np.testing.assert_array_equal(restored["w"], _state(5)["w"])
+        finally:
+            mgr.close()
+
+    def test_shape_mismatch_not_retried(self, tmp_path):
+        """ValueError is fatal (default_is_retryable): the shape-mismatch
+        guidance must surface after ONE attempt, not a retry storm."""
+        mgr = self._mgr(tmp_path)
+        try:
+            mgr.save(1, _state(1))
+            calls = []
+            original = mgr._mgr.restore
+
+            def mismatch(step, args=None):
+                calls.append(1)
+                raise ValueError(
+                    "shape (8,) not compatible with the stored shape (4,)")
+
+            mgr._mgr.restore = mismatch
+            try:
+                with pytest.raises(RuntimeError,
+                                   match="do not match this run's config"):
+                    mgr.restore(_state())
+            finally:
+                mgr._mgr.restore = original
+            assert len(calls) == 1
+        finally:
+            mgr.close()
+
+    def test_permanent_read_failure_names_op(self, tmp_path):
+        mgr = ckpt_lib.CheckpointManager(
+            str(tmp_path / "c"), async_save=False,
+            retry_policy=NO_SLEEP.with_(max_attempts=2))
+        try:
+            original = mgr._mgr.latest_step
+            mgr._mgr.latest_step = lambda: (_ for _ in ()).throw(
+                IOError("gone"))
+            try:
+                with pytest.raises(IOError, match="failed after 2 attempts"):
+                    mgr.latest_step()
+            finally:
+                mgr._mgr.latest_step = original
+        finally:
+            mgr.close()
+
+
+class TestCheckpointExitSafety:
+    def test_exception_unwind_drains_async_save(self, tmp_path):
+        with pytest.raises(RuntimeError, match="boom"):
+            with ckpt_lib.CheckpointManager(str(tmp_path / "c"),
+                                            async_save=True) as mgr:
+                mgr.save(1, _state(1), force=True)
+                raise RuntimeError("boom")  # async save may be in flight
+        with ckpt_lib.CheckpointManager(str(tmp_path / "c")) as mgr2:
+            assert mgr2.latest_step() == 1  # the save became durable
+
+    def test_close_failure_does_not_mask_original(self, tmp_path):
+        mgr = ckpt_lib.CheckpointManager(str(tmp_path / "c"),
+                                         async_save=False)
+        original_close = mgr.close
+
+        def bad_close():
+            raise IOError("storage vanished during unwind")
+
+        mgr.close = bad_close
+        try:
+            with pytest.raises(RuntimeError, match="boom"):
+                with mgr:
+                    raise RuntimeError("boom")
+        finally:
+            mgr.close = original_close
+            mgr.close()
+
+
+class TestResumeMetaTolerance:
+    def test_corrupt_sidecar_returns_none_and_counts(self, tmp_path):
+        d = str(tmp_path)
+        with open(os.path.join(d, tasks._RESUME_META), "w") as f:
+            f.write('{"step": 7, "epo')  # torn mid-json.dump write
+        th = guard_lib.TrainHealth()
+        assert tasks._read_resume_meta(d, health=th) is None
+        assert th.resume_meta_corrupt == 1
+
+    def test_valid_sidecar_reads_back(self, tmp_path):
+        d = str(tmp_path)
+        tasks._write_resume_meta(d, {"step": 7, "epoch": 1})
+        th = guard_lib.TrainHealth()
+        assert tasks._read_resume_meta(d, health=th) == {"step": 7,
+                                                         "epoch": 1}
+        assert th.resume_meta_corrupt == 0
+
+    def test_missing_sidecar_is_clean(self, tmp_path):
+        th = guard_lib.TrainHealth()
+        assert tasks._read_resume_meta(str(tmp_path), health=th) is None
+        assert th.resume_meta_corrupt == 0
+
+
+# -- task-level integration ------------------------------------------------
+
+@pytest.fixture(scope="module")
+def workdir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("preempt")
+    libsvm.generate_synthetic_ctr(
+        str(d / "data"), num_files=2, examples_per_file=48,
+        feature_size=FEATURE_SIZE, field_size=FIELD_SIZE, prefix="tr",
+        seed=5)
+    return d
+
+
+def _cfg(workdir, model_dir, **kw):
+    base = dict(
+        task_type="train", data_dir=str(workdir / "data"),
+        model_dir=model_dir, feature_size=FEATURE_SIZE,
+        field_size=FIELD_SIZE, embedding_size=4, deep_layers="8",
+        dropout="1.0", batch_size=16, num_epochs=2,
+        compute_dtype="float32", mesh_data=1, log_steps=0,
+        scale_lr_by_world=False, seed=17, verify_crc=True,
+        io_retry_backoff_secs=0.0)
+    base.update(kw)
+    return Config(**base)
+
+
+def _final_params(cfg):
+    trainer = Trainer(cfg)
+    with ckpt_lib.CheckpointManager(cfg.model_dir) as mgr:
+        state = mgr.restore(trainer.init_state())
+    return jax.tree.map(np.asarray, state.params), int(state.step)
+
+
+def _assert_params_equal(a, b, what):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=what)
+
+
+@pytest.fixture(scope="module")
+def baseline(workdir):
+    """Uninterrupted 2-epoch run: the parity oracle for preempt-resume and
+    rollback-replay (checkpoint cadence never changes the trajectory)."""
+    cfg = _cfg(workdir, str(workdir / "ckpt_base"))
+    res = tasks.run(cfg)
+    params, step = _final_params(cfg)
+    assert step == 2 * BATCHES_PER_EPOCH
+    return params, step, res
+
+
+@pytest.fixture(autouse=True)
+def _clean_listener():
+    """The process-wide listener flag must never leak between tests."""
+    yield
+    preempt_lib.get_listener().clear()
+
+
+class TestPreemptResume:
+    def test_injected_preemption_then_resume_is_bit_identical(
+            self, workdir, baseline, monkeypatch):
+        params_base, step_base, _ = baseline
+        ckpt = str(workdir / "ckpt_preempted")
+        cfg = _cfg(workdir, ckpt)
+
+        # Phase 1: the injectable trigger fires mid-epoch; the task
+        # force-saves and raises Preempted (the launcher maps it to 42).
+        monkeypatch.setenv("DEEPFM_TPU_PREEMPT_AFTER_STEPS", "3")
+        with pytest.raises(preempt_lib.Preempted) as ei:
+            tasks.run(cfg)
+        assert ei.value.step == 3
+        _, step_saved = _final_params(cfg)
+        assert step_saved == 3  # the forced preemption save landed
+        meta = tasks._read_resume_meta(ckpt)
+        assert meta["step"] == 3 and not meta["completed"]
+
+        # Phase 2: restart (fresh listener state), resume to completion.
+        monkeypatch.delenv("DEEPFM_TPU_PREEMPT_AFTER_STEPS")
+        preempt_lib.get_listener().clear()
+        res = tasks.run(cfg)
+        assert res["preemptions"] == 0.0
+        params, step = _final_params(cfg)
+        assert step == step_base
+        _assert_params_equal(params_base, params,
+                             "preempt-resume vs uninterrupted baseline")
+
+    def test_flag_set_before_training_preempts_at_first_dispatch(
+            self, workdir):
+        ckpt = str(workdir / "ckpt_early")
+        listener = preempt_lib.get_listener()
+        listener.trigger("notice during startup")
+        with pytest.raises(preempt_lib.Preempted) as ei:
+            tasks.run(_cfg(workdir, ckpt))
+        assert ei.value.step == 1  # first dispatch finished, then exit
+
+
+class _TBRecorder:
+    calls = []
+
+    def __init__(self, logdir):
+        pass
+
+    def scalars(self, step, **values):
+        _TBRecorder.calls.append((step, values))
+
+    def close(self):
+        pass
+
+
+class TestGuardPoliciesTaskLevel:
+    def test_skip_counts_in_result_and_tensorboard(self, workdir,
+                                                   monkeypatch):
+        _TBRecorder.calls = []
+        monkeypatch.setattr(tasks, "_TensorBoardWriter", _TBRecorder)
+        faults.set_nan_plan([2])
+        cfg = _cfg(workdir, str(workdir / "ckpt_skip"),
+                   on_nonfinite="skip")
+        res = tasks.run(cfg)
+        assert res["nonfinite_skips"] == 1.0
+        assert res["rollbacks"] == 0.0
+        # the poisoned dispatch was consumed but not trained
+        assert res["steps"] == 2 * BATCHES_PER_EPOCH - 1
+        health_calls = [v for _, v in _TBRecorder.calls
+                        if "health/nonfinite_skips" in v]
+        assert health_calls and \
+            health_calls[-1]["health/nonfinite_skips"] == 1.0
+
+    def test_rollback_replays_from_checkpoint_bit_identically(
+            self, workdir, baseline):
+        params_base, step_base, _ = baseline
+        # Checkpoints at steps 2 and 4; batch index 4 (dispatch 5) poisons.
+        # Rollback restores step 4 and replays from the recorded offset —
+        # with the plan consumed, the replayed batch is clean, so the final
+        # params must match the uninterrupted baseline exactly.
+        faults.set_nan_plan([4])
+        cfg = _cfg(workdir, str(workdir / "ckpt_rollback"),
+                   on_nonfinite="rollback", save_checkpoints_steps=2)
+        res = tasks.run(cfg)
+        assert res["rollbacks"] == 1.0
+        assert res["steps"] == step_base
+        params, step = _final_params(cfg)
+        assert step == step_base
+        _assert_params_equal(params_base, params,
+                             "rollback-replay vs uninterrupted baseline")
+
+    def test_rollback_without_checkpoint_aborts(self, workdir):
+        faults.set_nan_plan([1])
+        cfg = _cfg(workdir, "", on_nonfinite="rollback")
+        with pytest.raises(guard_lib.NonFiniteError,
+                           match="no checkpoint exists"):
+            tasks.run(cfg)
+
+    def test_abort_raises_with_step_number(self, workdir):
+        faults.set_nan_plan([1])
+        cfg = _cfg(workdir, str(workdir / "ckpt_abort"),
+                   on_nonfinite="abort", log_steps=1)
+        with pytest.raises(guard_lib.NonFiniteError, match="at step 2"):
+            tasks.run(cfg)
+
+
+class TestCorruptSidecarResume:
+    def test_task_degrades_to_checkpoint_step_resume(self, workdir):
+        ckpt = str(workdir / "ckpt_torn")
+        cfg = _cfg(workdir, ckpt, num_epochs=1)
+        tasks.run(cfg)
+        with open(os.path.join(ckpt, tasks._RESUME_META), "w") as f:
+            f.write('{"step": 6, "ep')  # torn write mid-preemption
+        res = tasks.run(cfg)  # must not raise: sidecar-free resume
+        assert res["resume_meta_corrupt"] >= 1.0
+        # checkpoint-step-only fallback: the epoch replays (reference
+        # behavior), training continues past the restored step
+        assert res["steps"] == 2 * BATCHES_PER_EPOCH
+
+
+@pytest.mark.slow
+def test_preempt_drill_end_to_end(tmp_path):
+    """The full acceptance drill (SIGTERM a live subprocess mid-epoch,
+    supervised restart loop, bit-identity with the uninterrupted baseline,
+    staged + device-resident paths). Slow: spawns several real launcher
+    subprocesses; excluded from tier-1, run via scripts/preempt_drill.py."""
+    import preempt_drill
+    preempt_drill.run_drill(str(tmp_path), verbose=False)
+
+
+class TestLaunchExitCode:
+    def test_preempted_maps_to_exit_42(self, workdir, monkeypatch, capsys):
+        from deepfm_tpu import launch
+
+        def fake_run(cfg):
+            raise preempt_lib.Preempted(7, "test")
+
+        monkeypatch.setattr(tasks, "run", fake_run)
+        rc = launch.main(["--task_type", "train",
+                          "--data_dir", str(workdir / "data")])
+        assert rc == preempt_lib.EXIT_PREEMPTED
+        out = capsys.readouterr().out
+        assert '"preempted": true' in out and '"step": 7' in out
