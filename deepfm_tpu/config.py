@@ -103,6 +103,13 @@ class Config:
     device_dataset: bool = False
     device_dataset_hbm_fraction: float = 0.6
     use_native_decoder: bool = True   # C++ TFRecord decode path
+    # Fused decode->assemble: one C call per shuffle-pool drain writes
+    # decoded records straight into the transfer-layout pool. Kill switch
+    # only — emission is bit-identical with it off (per-chunk scatter) —
+    # but it is part of the consumption-layout fingerprint so a resumed
+    # run never mixes probe outcomes mid-epoch. No-op without the native
+    # decoder or on a stale prebuilt .so lacking the entry point.
+    native_assembly: bool = True
     # CRC32C-check every record. Default False for speed: skipping the
     # check buys ~15-20% host decode throughput on a 1-core host (TUNING.md).
     # NOTE this is a deliberate parity DEVIATION, not parity: TF's record

@@ -20,9 +20,11 @@ Outputs fixed-shape batches ``{"feat_ids": int32[B,F], "feat_vals": f32[B,F],
 from __future__ import annotations
 
 import collections
+import contextlib
 import os
 import queue
 import threading
+import time
 from typing import BinaryIO, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -67,6 +69,26 @@ _NATIVE_CHUNK_BYTES = 64 << 20
 # Module-level so tests can lower it to exercise the split arithmetic.
 _SCATTER_SPLIT_MIN = 4096
 
+# Read size used past a file's stat()ed length: files can grow between the
+# stat and the read, so probe for extra bytes — but with a bounded request,
+# not a full chunk (BufferedReader pre-allocates the entire requested size,
+# so a 64MB request that returns 0 bytes at EOF still costs a 64MB alloc).
+_EOF_PROBE_BYTES = 64 << 10
+
+# Env knob for scripts/bench_multiprocess.py: inflate the host emission cost
+# by N synthetic ns/record (a GIL-releasing sleep in the drain), making the
+# host path the bottleneck even on a 1-core box so the transfer-ahead
+# overlap A/B has something to overlap. Never set in production.
+_SYNTH_STALL_ENV = "DEEPFM_TPU_SYNTH_HOST_NS_PER_RECORD"
+
+
+def _timed(stats, name: str):
+    """Stage-timing context: records wall ns into ``stats`` (a
+    ``profiling.HostStageStats``), or free when no collector is attached."""
+    if stats is None:
+        return contextlib.nullcontext()
+    return stats.stage(name)
+
 
 def _native_loader():
     """The native decoder module, or None when toolchain/build unavailable."""
@@ -80,7 +102,8 @@ def _native_loader():
 
 
 def _iter_framed_stream(stream: BinaryIO, loader, verify_crc: bool = True,
-                        *, path: str = "", policy: Optional[BadRecordPolicy] = None
+                        *, path: str = "", policy: Optional[BadRecordPolicy] = None,
+                        size_hint: Optional[int] = None, stats=None
                         ) -> Iterator[Tuple[bytes, np.ndarray, np.ndarray]]:
     """Chunked read() + C-speed framing with a carried partial tail: yields
     (buf, offsets, lengths) per chunk from any sequential byte source.
@@ -95,35 +118,56 @@ def _iter_framed_stream(stream: BinaryIO, loader, verify_crc: bool = True,
     applies the same raise/skip ``policy`` as the pure-Python decode path —
     so both decoder paths surface identical locations and skip-policy
     behavior. Clean data never takes the re-scan, keeping the fast path
-    byte-identical (TestPooledEmissionGolden)."""
+    byte-identical (TestPooledEmissionGolden).
+
+    ``size_hint`` (the stat()ed file length, when the caller has one) caps
+    each read request at the bytes actually remaining: BufferedReader
+    pre-allocates the full requested size per call, so an unhinted 64MB
+    request against a 5MB file costs a 64MB alloc + trim every chunk — the
+    second-largest host-path overhead in the r6 per-stage breakdown. Past
+    the hint the loop keeps reading in ``_EOF_PROBE_BYTES`` requests (files
+    may grow after the stat), so the emitted spans are identical with or
+    without the hint."""
     carry = b""
     carry_base = 0  # absolute stream offset of carry[0]
     read_size = _NATIVE_CHUNK_BYTES
+    pos = 0  # bytes read from the stream so far
     while True:
-        chunk = stream.read(read_size)
+        if size_hint is not None and size_hint > pos:
+            want = min(read_size, size_hint - pos)
+        elif size_hint is not None:
+            want = _EOF_PROBE_BYTES
+        else:
+            want = read_size
+        with _timed(stats, "read"):
+            chunk = stream.read(want)
         if not chunk:
             if carry:
                 # Strict parse of the leftover: surfaces truncated-input
                 # as an error (or a counted skip under the policy).
-                try:
-                    offsets, lengths = loader.split_frames(
-                        carry, verify_crc=verify_crc)
-                except IOError:
-                    offsets, lengths, _, _ = tfrecord.scan_frames_partial(
-                        carry, verify_crc=verify_crc, final=True,
-                        base_offset=carry_base, path=path, policy=policy)
+                with _timed(stats, "frame"):
+                    try:
+                        offsets, lengths = loader.split_frames(
+                            carry, verify_crc=verify_crc)
+                    except IOError:
+                        offsets, lengths, _, _ = tfrecord.scan_frames_partial(
+                            carry, verify_crc=verify_crc, final=True,
+                            base_offset=carry_base, path=path, policy=policy)
                 yield carry, offsets, lengths
             return
+        pos += len(chunk)
         buf = carry + chunk if carry else chunk
         buf_base = carry_base
         abort = False
-        try:
-            offsets, lengths, consumed = loader.split_frames_partial(
-                buf, verify_crc=verify_crc)
-        except IOError:
-            offsets, lengths, consumed, abort = tfrecord.scan_frames_partial(
-                buf, verify_crc=verify_crc, final=False,
-                base_offset=buf_base, path=path, policy=policy)
+        with _timed(stats, "frame"):
+            try:
+                offsets, lengths, consumed = loader.split_frames_partial(
+                    buf, verify_crc=verify_crc)
+            except IOError:
+                offsets, lengths, consumed, abort = \
+                    tfrecord.scan_frames_partial(
+                        buf, verify_crc=verify_crc, final=False,
+                        base_offset=buf_base, path=path, policy=policy)
         yield buf, offsets, lengths
         if abort:  # framing cannot resync past the corruption
             return
@@ -146,14 +190,21 @@ def _health_retry_cb(policy: Optional[BadRecordPolicy], path: str):
 
 def _iter_framed_chunks(path: str, loader, verify_crc: bool = True, *,
                         policy: Optional[BadRecordPolicy] = None,
-                        retry_policy=None
+                        retry_policy=None, stats=None
                         ) -> Iterator[Tuple[bytes, np.ndarray, np.ndarray]]:
     """File-path front-end of ``_iter_framed_stream`` (local or gs://),
-    reading through a ResilientStream so transient mid-file errors heal."""
+    reading through a ResilientStream so transient mid-file errors heal.
+    The stat()ed length becomes the framer's ``size_hint`` (right-sized
+    read buffers); a failed stat degrades to unhinted reads, not an error."""
+    try:
+        size_hint: Optional[int] = fileio.size(path)
+    except Exception:
+        size_hint = None
     with fileio.open_resilient(path, policy=retry_policy,
                                on_retry=_health_retry_cb(policy, path)) as f:
         yield from _iter_framed_stream(f, loader, verify_crc,
-                                       path=path, policy=policy)
+                                       path=path, policy=policy,
+                                       size_hint=size_hint, stats=stats)
 
 
 def _iter_file_records(path: str, use_native: bool, verify_crc: bool = True,
@@ -262,6 +313,7 @@ class CtrPipeline:
         shard: Optional[sharding.ShardSpec] = None,
         prefetch_batches: int = 4,
         use_native_decoder: bool = True,
+        native_assembly: bool = True,
         reader_threads: int = 4,
         verify_crc: bool = False,  # speed-over-parity default (see Config); codec fns keep True
         epoch_offset: int = 0,
@@ -298,7 +350,17 @@ class CtrPipeline:
         # not os.cpu_count() (physical cores).
         self.reader_threads = max(1, min(reader_threads, _available_cores()))
         self._use_native = use_native_decoder
+        # Fused decode->assemble (one C call per drain writing straight into
+        # the transfer-layout pool). Off = per-chunk scatter-decode, which
+        # emits bit-identical bytes — the flag exists as a kill switch and
+        # for the bench/tests to measure and pin that parity. Ignored when
+        # the built .so predates the entry point (loader.has_assemble()).
+        self.native_assembly = bool(native_assembly)
         self.verify_crc = verify_crc
+        # Optional per-stage wall-time collector (profiling.HostStageStats).
+        # None outside the bench: every timing site no-ops through _timed.
+        self.stage_stats = None
+        self._synth_stall_ns = float(os.environ.get(_SYNTH_STALL_ENV) or 0.0)
         # Shifts the internal epoch index used for shuffle seeding. The task
         # driver recreates the pipeline per epoch with num_epochs=1 (the
         # reference's file-mode shape, 2-hvd-gpu/...py:390-394); without the
@@ -558,7 +620,8 @@ class CtrPipeline:
             for buf, offsets, lengths in _iter_framed_chunks(
                     path, loader, self.verify_crc,
                     policy=self._bad_policy,
-                    retry_policy=self._retry_policy):
+                    retry_policy=self._retry_policy,
+                    stats=self.stage_stats):
                 if len(offsets) == 0:
                     continue
                 got_any = True
@@ -585,12 +648,20 @@ class CtrPipeline:
     def _scatter_decode_raw(self, loader, raw, perm: np.ndarray, off: int,
                             labels: np.ndarray, ids: np.ndarray,
                             vals: np.ndarray, pool: "_DrainPool") -> None:
-        """Decode every raw span chunk straight into its permuted pool rows
-        (``loader.decode_spans_scatter``). Rows are disjoint across chunks
-        and the C call releases the GIL, so chunks decode on the reader
-        pool when more than one core is available; big single chunks are
-        split into contiguous sub-spans (>= _SCATTER_SPLIT_MIN records
-        each) to fill the pool."""
+        """Decode every raw span chunk straight into its permuted pool rows.
+        Rows are disjoint across chunks and the C calls release the GIL, so
+        chunks decode on the reader pool when more than one core is
+        available; big single chunks are split into contiguous sub-spans
+        (>= _SCATTER_SPLIT_MIN records each) to fill the pool.
+
+        With ``native_assembly`` and a library that exports the fused entry,
+        the single-threaded case crosses ctypes ONCE for the whole drain
+        (``loader.assemble_spans`` over every chunk) instead of once per
+        chunk — each GIL reacquisition after a released C call can stall up
+        to a switch interval behind the prefetch consumer, so on a loaded
+        1-core host the per-chunk calls cost real wall time. The threaded
+        case keeps per-sub-span calls (that's what parallelizes). Both
+        routes and the non-fused scatter emit bit-identical pool bytes."""
         jobs = []
         for buf, offsets, lengths in raw:
             m = len(offsets)
@@ -602,10 +673,24 @@ class CtrPipeline:
                              perm[off + s:off + e]))
             off += m
 
+        if (self.native_assembly and hasattr(loader, "has_assemble")
+                and loader.has_assemble()):
+            if len(jobs) <= 1 or self.reader_threads <= 1:
+                loader.assemble_spans(jobs, self.field_size,
+                                      labels, ids, vals)
+            else:
+                list(pool.get().map(
+                    lambda job: loader.assemble_spans(
+                        [job], self.field_size, labels, ids, vals),
+                    jobs))
+            return
+
+        lab_flat = labels.reshape(-1)
+
         def run(job):
             buf, offs, lens, dest = job
             loader.decode_spans_scatter(
-                buf, offs, lens, self.field_size, dest, labels, ids, vals)
+                buf, offs, lens, self.field_size, dest, lab_flat, ids, vals)
 
         if len(jobs) <= 1 or self.reader_threads <= 1:
             for job in jobs:
@@ -671,6 +756,8 @@ class CtrPipeline:
         # iterators of one pipeline must not share (advisor r5: the first
         # one's epoch-end close() killed the second's in-flight drain).
         drain_pool = _DrainPool(self.reader_threads)
+        stats = self.stage_stats
+        stall_ns = self._synth_stall_ns
         try:
             for e in range(self.num_epochs):
                 epoch = e + self.epoch_offset
@@ -694,21 +781,30 @@ class CtrPipeline:
                         # decoded) scatters first, then raw chunks decode
                         # directly to their rows — matching the arrival order
                         # the permutation indexes.
-                        perm = rng.permutation(n_pend)
-                        labels = np.empty((n_pend,), np.float32)
-                        ids = np.empty((n_pend, self.field_size), np.int32)
-                        vals = np.empty((n_pend, self.field_size), np.float32)
-                        off = 0
-                        for lab, idx, val in pend:
-                            dest = perm[off:off + len(lab)]
-                            labels[dest] = lab
-                            ids[dest] = idx
-                            vals[dest] = val
-                            off += len(lab)
-                        if raw:
-                            self._scatter_decode_raw(
-                                loader, raw, perm, off, labels, ids, vals,
-                                drain_pool)
+                        with _timed(stats, "decode_assemble"):
+                            perm = rng.permutation(n_pend)
+                            # Transfer-layout pool: the label column is
+                            # [n, 1] so a batch slice IS the emitted
+                            # ``label`` array (the 1-D pool forced a full
+                            # reshape+astype copy per emission). Same bytes,
+                            # one less pass per batch.
+                            labels = np.empty((n_pend, 1), np.float32)
+                            lab_col = labels.reshape(-1)
+                            ids = np.empty((n_pend, self.field_size),
+                                           np.int32)
+                            vals = np.empty((n_pend, self.field_size),
+                                            np.float32)
+                            off = 0
+                            for lab, idx, val in pend:
+                                dest = perm[off:off + len(lab)]
+                                lab_col[dest] = lab.reshape(-1)
+                                ids[dest] = idx
+                                vals[dest] = val
+                                off += len(lab)
+                            if raw:
+                                self._scatter_decode_raw(
+                                    loader, raw, perm, off, labels, ids,
+                                    vals, drain_pool)
                         pend = collections.deque([(labels, ids, vals)])
                         raw = []
                         if service is not None:
@@ -717,14 +813,26 @@ class CtrPipeline:
                             # back so workers refill them while we slice.
                             service.release_consumed()
                     while n_pend >= sb:
-                        yield self._assemble_batch(pend, sb), k, sb
+                        with _timed(stats, "emit"):
+                            rows = self._assemble_batch(pend, sb)
+                        if stall_ns:
+                            time.sleep(stall_ns * sb * 1e-9)
+                        yield rows, k, sb
                         n_pend -= sb
                     if final:
                         while n_pend >= bs:
-                            yield self._assemble_batch(pend, bs), 1, bs
+                            with _timed(stats, "emit"):
+                                rows = self._assemble_batch(pend, bs)
+                            if stall_ns:
+                                time.sleep(stall_ns * bs * 1e-9)
+                            yield rows, 1, bs
                             n_pend -= bs
                         if n_pend and not self.drop_remainder:
-                            yield self._assemble_batch(pend, n_pend), 1, n_pend
+                            with _timed(stats, "emit"):
+                                rows = self._assemble_batch(pend, n_pend)
+                            if stall_ns:
+                                time.sleep(stall_ns * n_pend * 1e-9)
+                            yield rows, 1, n_pend
                             n_pend = 0
 
                 if cached_cols is not None:
@@ -817,10 +925,15 @@ class CtrPipeline:
             labels = np.concatenate([t[0] for t in take])
             ids = np.concatenate([t[1] for t in take])
             vals = np.concatenate([t[2] for t in take])
+        # ascontiguousarray, not astype: a contiguous float32 pool slice
+        # (the shuffled drain's [n, 1] label column, and all ids/vals)
+        # passes through as a zero-copy view — same bytes, no per-emission
+        # label copy. Non-contiguous or 1-D chunk labels still normalize
+        # to the same [bs, 1] float32 layout.
         return {
             "feat_ids": np.ascontiguousarray(ids, np.int32),
             "feat_vals": np.ascontiguousarray(vals, np.float32),
-            "label": labels.reshape(-1, 1).astype(np.float32),
+            "label": np.ascontiguousarray(labels.reshape(-1, 1), np.float32),
         }
 
     # ------------------------------------------------------------------

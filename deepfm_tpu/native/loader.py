@@ -92,6 +92,23 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_long),
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_long)]
+        # Fused multi-chunk assemble entry: absent from pre-r6 cached .so
+        # builds (the mtime check rebuilds when the source is newer, but a
+        # clock-skewed checkout can leave a stale library) — probe instead
+        # of assuming, and let callers key off has_assemble().
+        try:
+            lib.dfm_decode_ctr_assemble.restype = ctypes.c_long
+            lib.dfm_decode_ctr_assemble.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_long), ctypes.c_long, ctypes.c_long,
+                ctypes.POINTER(ctypes.c_long),
+                ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_long)]
+        except AttributeError:
+            pass
         lib.dfm_crc32c.restype = ctypes.c_uint32
         lib.dfm_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_long]
         _lib = lib
@@ -251,6 +268,119 @@ def decode_spans_scatter(buf, offsets: np.ndarray, lengths: np.ndarray,
         raise ValueError(
             f"native scatter-decode failed at span-local record {-rc - 100}: "
             f"{_decode_reason(detail.value, field_size)}")
+
+
+def has_assemble() -> bool:
+    """True when the built library exports the fused multi-chunk
+    decode->assemble entry (``dfm_decode_ctr_assemble``). False on a stale
+    cached .so from an older source tree — callers fall back to the
+    per-chunk ``decode_spans_scatter`` path, which emits identical bytes."""
+    lib = _load()
+    return lib is not None and hasattr(lib, "dfm_decode_ctr_assemble")
+
+
+def _validate_assemble_jobs(jobs, labels, ids, vals):
+    """Shared bounds check for the fused entry and its Python fallback: the
+    C side scatters unchecked, so every destination row must be validated
+    before the pointers are handed over (same contract as
+    ``decode_spans_scatter``)."""
+    assert labels.flags.c_contiguous and ids.flags.c_contiguous \
+        and vals.flags.c_contiguous
+    rows = min(labels.shape[0], ids.shape[0], vals.shape[0])
+    for offsets, _, dest in ((j[1], j[2], j[3]) for j in jobs):
+        if len(dest) != len(offsets):
+            raise ValueError(
+                f"assemble_spans: len(dest)={len(dest)} != "
+                f"len(offsets)={len(offsets)}")
+        if len(dest) and (int(dest.min()) < 0 or int(dest.max()) >= rows):
+            raise ValueError(
+                f"assemble_spans: dest range [{int(dest.min())}, "
+                f"{int(dest.max())}] outside pool of {rows} rows")
+
+
+def assemble_spans(jobs, field_size: int, labels: np.ndarray,
+                   ids: np.ndarray, vals: np.ndarray) -> None:
+    """Fused decode->assemble: decode EVERY framed chunk span straight into
+    its permuted rows of the transfer-layout output buffers, in ONE
+    GIL-released C call per drain.
+
+    ``jobs`` is a sequence of ``(buf, offsets, lengths, dest)`` — chunk
+    bytes plus int64 span/destination arrays; ``labels`` is the label
+    column ([P] or [P, 1] float32 — same contiguous memory either way),
+    ``ids``/``vals`` are [P, field_size]. The caller owns destination
+    bounds and disjointness, exactly like ``decode_spans_scatter``; unlike
+    it, the whole drain crosses ctypes once, so a contended host pays one
+    GIL reacquisition per drain instead of one per chunk."""
+    lib = _load()
+    assert lib is not None
+    if not jobs:
+        return
+    if not hasattr(lib, "dfm_decode_ctr_assemble"):
+        # Stale .so without the entry: per-chunk scatter, identical bytes.
+        for buf, offsets, lengths, dest in jobs:
+            decode_spans_scatter(buf, offsets, lengths, field_size, dest,
+                                 labels.reshape(-1), ids, vals)
+        return
+    n_chunks = len(jobs)
+    norm = []
+    for buf, offsets, lengths, dest in jobs:
+        norm.append((buf,
+                     np.ascontiguousarray(offsets, dtype=np.int64),
+                     np.ascontiguousarray(lengths, dtype=np.int64),
+                     np.ascontiguousarray(dest, dtype=np.int64)))
+    _validate_assemble_jobs(norm, labels, ids, vals)
+    # Per-chunk pointer tables + the concatenated dest vector. The np
+    # arrays in ``norm`` (and the raw buffers) stay referenced until the
+    # call returns, so every pointer below stays live.
+    bufs_arr = (ctypes.c_void_p * n_chunks)(
+        *(ctypes.cast(_as_ubyte_ptr(j[0]), ctypes.c_void_p) for j in norm))
+    offs_arr = (ctypes.c_void_p * n_chunks)(
+        *(j[1].ctypes.data for j in norm))
+    lens_arr = (ctypes.c_void_p * n_chunks)(
+        *(j[2].ctypes.data for j in norm))
+    counts = np.fromiter((len(j[1]) for j in norm), dtype=np.int64,
+                         count=n_chunks)
+    dest_all = (norm[0][3] if n_chunks == 1
+                else np.concatenate([j[3] for j in norm]))
+    err_chunk = ctypes.c_long(-1)
+    detail = ctypes.c_long(0)
+    rc = lib.dfm_decode_ctr_assemble(
+        bufs_arr, offs_arr, lens_arr,
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        n_chunks, field_size,
+        dest_all.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.byref(err_chunk), ctypes.byref(detail))
+    if rc != 0:
+        raise ValueError(
+            f"native assemble failed at record {-rc - 100} of chunk "
+            f"{err_chunk.value}: {_decode_reason(detail.value, field_size)}")
+
+
+def assemble_spans_python(jobs, field_size: int, labels: np.ndarray,
+                          ids: np.ndarray, vals: np.ndarray) -> None:
+    """Pure-Python mirror of ``assemble_spans`` (bit-identical emission):
+    each record decodes with the Python Example codec straight into its
+    destination row of the same transfer-layout buffers. The reference
+    implementation the fused C entry is tested against, and the forced
+    fallback when the toolchain is unavailable."""
+    from ..data import example_codec  # noqa: PLC0415 (avoid module cycle)
+    _validate_assemble_jobs(
+        [(j[0], np.asarray(j[1]), np.asarray(j[2]), np.asarray(j[3]))
+         for j in jobs],
+        labels, ids, vals)
+    lab_flat = labels.reshape(-1)
+    for buf, offsets, lengths, dest in jobs:
+        for off, ln, d in zip(np.asarray(offsets).tolist(),
+                              np.asarray(lengths).tolist(),
+                              np.asarray(dest).tolist()):
+            lab, rid, rval = example_codec.decode_ctr_example(
+                bytes(buf[off:off + ln]), field_size)
+            lab_flat[d] = lab
+            ids[d] = rid.astype(np.int32)
+            vals[d] = rval
 
 
 def decode_batch(records: Sequence[bytes], field_size: int

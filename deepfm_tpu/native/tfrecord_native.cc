@@ -363,6 +363,50 @@ long dfm_decode_ctr_scatter(const uint8_t* buf, const long* offsets,
   return 0;
 }
 
+// Fused decode->assemble over MANY framed chunk spans in one call: the
+// whole pool drain (every raw chunk held since the last drain) decodes
+// straight into the permuted rows of the preallocated transfer-layout
+// output buffers — labels[P] (the [P,1] column of the emitted batch dict
+// is the same contiguous floats), ids[P*field_size], vals[P*field_size].
+// dest is the concatenated destination-row vector across chunks (chunk c's
+// records use dest[base_c .. base_c+counts[c])). One ctypes crossing and
+// one GIL release per drain instead of one per chunk: on a contended
+// 1-core host each C call's GIL reacquisition can stall up to a switch
+// interval behind the consumer thread, so fewer crossings is a real win,
+// not just call-overhead accounting.
+// Returns 0, or -(100+i) with i the record index WITHIN the failing chunk;
+// *err_chunk (if non-null) holds that chunk's index and *err_detail the
+// parse_ctr_example code (same contract as dfm_decode_ctr_ex).
+long dfm_decode_ctr_assemble(const uint8_t* const* bufs,
+                             const long* const* offsets,
+                             const long* const* lengths,
+                             const long* counts, long n_chunks,
+                             long field_size, const long* dest,
+                             float* labels, int32_t* ids, float* vals,
+                             long* err_chunk, long* err_detail) {
+  long base = 0;
+  for (long c = 0; c < n_chunks; ++c) {
+    const uint8_t* buf = bufs[c];
+    const long* off = offsets[c];
+    const long* len = lengths[c];
+    const long n = counts[c];
+    for (long i = 0; i < n; ++i) {
+      const uint8_t* p = buf + off[i];
+      const long d = dest[base + i];
+      long rc = parse_ctr_example(p, p + len[i], field_size, labels + d,
+                                  ids + d * field_size,
+                                  vals + d * field_size);
+      if (rc != 0) {
+        if (err_chunk) *err_chunk = c;
+        if (err_detail) *err_detail = rc;
+        return -(100 + i);
+      }
+    }
+    base += n;
+  }
+  return 0;
+}
+
 // Standalone CRC32C for tests.
 uint32_t dfm_crc32c(const uint8_t* data, long len) {
   init_crc_tables();

@@ -1162,18 +1162,27 @@ class Trainer:
             group.clear()
             return acc, dispatched
 
+        t_first_done = None  # wall clock after the first dispatch returned
+        n_first = 0          # real batches covered by that first dispatch
         for batch, real in staged:
             group.append(batch)
             n += int(real)  # real local batches only (dummies excluded)
             if len(group) == k:
                 acc, dispatched = flush(acc, dispatched)
+                if t_first_done is None:
+                    t_first_done = time.time()
+                    n_first = n
         if group:
             acc, dispatched = flush(acc, dispatched)
+            if t_first_done is None:
+                t_first_done = time.time()
+                n_first = n
         if dispatched == 0:
             # Nothing ran anywhere (a rank that only fed dummies still has a
             # valid psum-merged global acc and must NOT zero it out).
             return {"auc": 0.0, "loss": 0.0, "batches": 0.0,
-                    "examples_per_sec": 0.0}
+                    "examples_per_sec": 0.0,
+                    "examples_per_sec_steady": 0.0}
         auc_state, loss_state = acc
         auc = float(metrics_lib.auc_compute(auc_state))  # device sync
         n_examples = float(loss_state.count)  # global weighted count
@@ -1182,11 +1191,23 @@ class Trainer:
         # compile; steady-state callers (e.g. per-epoch eval after epoch 1)
         # see the amortized scanned-dispatch rate (VERDICT r3 #2).
         elapsed = max(time.time() - t_start, 1e-9)
+        raw_eps = n_examples / elapsed
+        # Steady-state rate: exclude the first dispatch (whose return time
+        # bounds the jit compile) from the window and its batches from the
+        # numerator. On a single-dispatch eval there is no steady window —
+        # report the raw rate so the key is always present and comparable.
+        first_elapsed = (t_first_done - t_start) if t_first_done else 0.0
+        if dispatched > 1 and n > n_first and elapsed - first_elapsed > 1e-9:
+            steady_eps = (n_examples * (n - n_first) / n) / (
+                elapsed - first_elapsed)
+        else:
+            steady_eps = raw_eps
         return {
             "auc": auc,
             "loss": float(metrics_lib.mean_compute(loss_state)),
             "batches": float(n),
-            "examples_per_sec": n_examples / elapsed,
+            "examples_per_sec": raw_eps,
+            "examples_per_sec_steady": steady_eps,
         }
 
     def _local_rows(self, arr: jax.Array) -> np.ndarray:

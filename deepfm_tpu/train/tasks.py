@@ -198,6 +198,7 @@ def make_pipeline(cfg: Config, files: List[str], *, epochs: int = 1,
         shard=_shard_spec(cfg, files) if sharded else None,
         prefetch_batches=cfg.prefetch_batches,
         use_native_decoder=cfg.use_native_decoder,
+        native_assembly=cfg.native_assembly,
         reader_threads=cfg.reader_threads,
         input_workers=cfg.input_workers,
         stall_timeout_s=cfg.dispatch_timeout_s,
@@ -485,10 +486,16 @@ def _consumption_layout(cfg: Config) -> List[int]:
     # decoded_cache changes chunk-arrival boundaries and therefore the pool
     # drain points whenever the pool is smaller than the epoch, so a resume
     # across cache modes must fall back to epoch-replay.
+    # native_assembly does NOT change emission bytes (fused and scatter
+    # paths are bit-identical), but it is consumption surface all the same:
+    # including it (a list-LENGTH change old sidecars can't match) makes a
+    # resume across the flag fall back to epoch-replay rather than trusting
+    # a fingerprint that never recorded which path ran.
     return [2, jax.process_count(), cfg.steps_per_loop,
             int(cfg.use_native_decoder), cfg.batch_size,
             cfg.shuffle_buffer, cfg.seed, int(cfg.drop_remainder),
-            int(cfg.shuffle_files), cache_lib.MODES.index(cfg.decoded_cache)]
+            int(cfg.shuffle_files), cache_lib.MODES.index(cfg.decoded_cache),
+            int(cfg.native_assembly)]
 
 
 def _resume_position(cfg: Config, restored_step: int,

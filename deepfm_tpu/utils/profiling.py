@@ -84,6 +84,42 @@ def annotate(name: str) -> Iterator[None]:
         yield
 
 
+class HostStageStats:
+    """Per-stage wall-time accumulator for the host input path.
+
+    The pipeline brackets each stage of its hot loop with ``stage(name)``
+    (``read`` — stream bytes in; ``frame`` — split TFRecord frames;
+    ``decode_assemble`` — proto decode scattered into the transfer-layout
+    pool; ``emit`` — slice/stack batches off the pool) when a collector is
+    attached via ``CtrPipeline.stage_stats``; detached (the default) every
+    site is a no-op. All stages run on the pipeline generator's thread —
+    even when the decode fans out to a reader pool, the bracket measures
+    the generator's wall wait — so the numbers add up to (most of) the
+    observed ns/record and the remainder is attributable Python glue.
+    """
+
+    def __init__(self) -> None:
+        self.ns: Dict[str, int] = {}
+        self.records = 0  # caller sets/accumulates the denominator
+
+    @contextlib.contextmanager
+    def stage(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter_ns()
+        try:
+            yield
+        finally:
+            self.ns[name] = self.ns.get(name, 0) + (
+                time.perf_counter_ns() - t0)
+
+    def ns_per_record(self, records: Optional[int] = None
+                      ) -> Dict[str, float]:
+        """Per-stage ns/record; pass ``records`` or preset ``.records``."""
+        n = records if records is not None else self.records
+        n = max(int(n), 1)
+        return {name: round(total / n, 1)
+                for name, total in sorted(self.ns.items())}
+
+
 class ThroughputMeter:
     """Step-time and examples/sec accumulator (host wall-clock).
 

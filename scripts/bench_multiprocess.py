@@ -18,6 +18,17 @@ best-of-N wins (same methodology as bench.py / BASELINE.md).
 Usage: python scripts/bench_multiprocess.py [--trials 3] [--quick]
 Prints one JSON line: {"serialized_eps": ..., "overlapped_eps": ...,
 "overlap_speedup": ...}.
+
+``--inflate-host-ns N`` adds a synthetic N ns/record stall to the host
+emission path of BOTH variants (a GIL-releasing sleep in the pipeline
+drain, via the DEEPFM_TPU_SYNTH_HOST_NS_PER_RECORD env var). On a 1-core
+host the un-inflated A/B is usually a wash — the CPU backend's "device"
+step and the host pipeline time-slice the same core, so there is nothing
+to overlap — but a sleep yields the core the way a real TPU dispatch
+does, so the overlapped variant hides the synthetic host cost behind the
+(time-sliced) step work and the speedup > 1 demonstrates the staging
+thread actually overlaps. This is a plumbing demonstration, not a
+throughput claim.
 """
 
 import argparse
@@ -48,20 +59,28 @@ def _free_port() -> int:
 
 
 def run_once(data_dir: str, model_dir: str, transfer_ahead: int,
-             epochs: int) -> float:
-    """One 2-process training run; returns rank-0 examples_per_sec."""
-    port = _free_port()
+             epochs: int, inflate_host_ns: int = 0,
+             world: int = 2) -> float:
+    """One training run (``world`` processes); returns rank-0
+    examples_per_sec. ``world=1`` skips the jax.distributed rendezvous
+    entirely — the only topology that runs on jaxlib builds whose CPU
+    backend lacks cross-process collectives."""
     env = dict(
         os.environ,
         JAX_PLATFORMS="cpu",
         XLA_FLAGS="--xla_force_host_platform_device_count=1",
         PYTHONPATH=_REPO,
     )
-    args = [
+    args = []
+    if inflate_host_ns:
+        env["DEEPFM_TPU_SYNTH_HOST_NS_PER_RECORD"] = str(inflate_host_ns)
+        # The pipeline's own decode-ahead thread (prefetch_batches) would
+        # hide the synthetic stall in BOTH variants, washing out the A/B.
+        # Pin it off so the Trainer staging thread is the only overlap
+        # mechanism under test.
+        args += ["--prefetch_batches", "0"]
+    args += [
         "--task_type", "train",
-        "--dist_mode", "1",
-        "--num_processes", "2",
-        "--coordinator_address", f"localhost:{port}",
         "--data_dir", data_dir,
         "--val_data_dir", "",
         "--model_dir", model_dir,
@@ -71,17 +90,23 @@ def run_once(data_dir: str, model_dir: str, transfer_ahead: int,
         "--dropout", "0.5,0.5,0.5", "--batch_size", "1024",
         "--num_epochs", str(epochs), "--learning_rate", "5e-4",
         "--compute_dtype", "bfloat16",
-        "--mesh_data", "2", "--mesh_model", "1",
+        "--mesh_data", str(world), "--mesh_model", "1",
         "--log_steps", "0", "--save_checkpoints_steps", "0",
         "--transfer_ahead", str(transfer_ahead),
         "--seed", "0",
     ]
+    if world > 1:
+        args += [
+            "--dist_mode", "1",
+            "--num_processes", str(world),
+            "--coordinator_address", f"localhost:{_free_port()}",
+        ]
     procs = [
         subprocess.Popen(
             [sys.executable, "-c", _RUNNER] + args + ["--process_id", str(r)],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             text=True, cwd=_REPO)
-        for r in range(2)
+        for r in range(world)
     ]
     outs = []
     for r, p in enumerate(procs):
@@ -97,11 +122,22 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--trials", type=int, default=3)
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--inflate-host-ns", type=int, default=0,
+                    help="synthetic host-path stall, ns/record, applied to "
+                         "BOTH variants (overlap demonstration on 1 core)")
+    ap.add_argument("--single", action="store_true",
+                    help="1 process, no jax.distributed: same A/B through "
+                         "Trainer._stage's prefetch thread; the only mode "
+                         "that runs when the CPU backend lacks cross-"
+                         "process collectives")
     args = ap.parse_args()
 
     from deepfm_tpu.data import libsvm
 
-    n_files, per_file = (4, 2048) if args.quick else (4, 8192)
+    # File-mode fits once per epoch with a fresh ThroughputMeter, so each
+    # epoch needs >2 dispatch groups (meter warmup) to measure anything:
+    # 4 files x 8192 records / 1024 world batch = 32 steps = 4 groups.
+    n_files, per_file = 4, 8192
     epochs = 1 if args.quick else 2
     with tempfile.TemporaryDirectory() as root:
         data = os.path.join(root, "data")
@@ -109,21 +145,29 @@ def main() -> None:
             data, num_files=n_files, examples_per_file=per_file,
             feature_size=117581, field_size=39, prefix="tr", seed=1)
 
+        world = 1 if args.single else 2
         best = {0: 0.0, 2: 0.0}
         for t in range(args.trials):
             for ahead in (0, 2):  # interleaved: weather hits both equally
                 eps = run_once(data, os.path.join(root, f"m{t}_{ahead}"),
-                               ahead, epochs)
+                               ahead, epochs,
+                               inflate_host_ns=args.inflate_host_ns,
+                               world=world)
                 best[ahead] = max(best[ahead], eps)
                 print(f"trial {t} transfer_ahead={ahead}: {eps:,.0f} ex/s",
                       file=sys.stderr)
 
-        print(json.dumps({
-            "topology": "2-process jax.distributed, CPU backend, 1 host core",
+        out = {
+            "topology": f"{world}-process"
+                        + ("" if args.single else " jax.distributed")
+                        + ", CPU backend, 1 host core",
             "serialized_eps": round(best[0], 1),
             "overlapped_eps": round(best[2], 1),
             "overlap_speedup": round(best[2] / max(best[0], 1e-9), 3),
-        }))
+        }
+        if args.inflate_host_ns:
+            out["inflate_host_ns_per_record"] = args.inflate_host_ns
+        print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -195,9 +195,14 @@ class TestSkipBudget:
 
 class TestTransientReadErrors:
     @pytest.mark.parametrize("native", NATIVE)
-    def test_mid_file_fault_heals_to_clean_output(self, data_dir, native):
+    def test_mid_file_fault_heals_to_clean_output(self, data_dir, native,
+                                                  monkeypatch):
         path = _files(data_dir)[1]
         clean = list(tfrecord.iter_records(path, verify_crc=True))
+        # Size-hinted reads pull a small file in ONE read call, so shrink
+        # the chunk size to force genuinely mid-file read boundaries for
+        # the every-Nth-read injector to land on.
+        monkeypatch.setattr(pipeline, "_NATIVE_CHUNK_BYTES", 512)
         health = DataHealth()
         with faults.FlakyFS(read_fail_every=3) as fs:
             out = _read(path, native, BadRecordPolicy("raise", 0, health))

@@ -840,6 +840,66 @@ class TestPooledEmissionGolden:
             h.update(rows["label"].tobytes())
         assert h.hexdigest()[:24] == self.GOLDEN[(8, 64, 0, True)]
 
+    @pytest.mark.skipif(not pipeline._native_loader(),
+                        reason="native decoder unavailable")
+    def test_fused_assembly_matches_golden_and_fallback(self, golden_files):
+        """The r5 fused decode->assemble drain (native_assembly=True, the
+        default) and the forced per-chunk scatter fallback must BOTH emit
+        the pinned golden stream — the kill switch changes no bytes."""
+        for (k, bs, skip, drop), want in self.GOLDEN.items():
+            fused = self._emission_hash(golden_files, k, bs, skip, drop,
+                                        native_assembly=True)
+            fallback = self._emission_hash(golden_files, k, bs, skip, drop,
+                                           native_assembly=False)
+            assert fused == want, (
+                f"fused emission changed for (k={k}, bs={bs}): {fused}")
+            assert fallback == want, (
+                f"fallback emission changed for (k={k}, bs={bs}): {fallback}")
+
+    @pytest.mark.skipif(not pipeline._native_loader(),
+                        reason="native decoder unavailable")
+    def test_bad_record_parity_through_fused_path(self, golden_files,
+                                                  monkeypatch):
+        """A corrupt record under on_bad_record='skip' must produce the
+        same emission through the fused drain as through the per-chunk
+        fallback: the skip happens at the framing layer, BEFORE spans reach
+        either assembly path, so both see the identical span stream.
+        Shrink the chunk size so the file spans several read boundaries."""
+        import hashlib
+        import struct
+
+        monkeypatch.setattr(pipeline, "_NATIVE_CHUNK_BYTES", 2048)
+        # flip one data-CRC byte mid-file: framing intact, record bad
+        path = golden_files[0]
+        data = bytearray(open(path, "rb").read())
+        pos = 0
+        for _ in range(100):  # walk to the 101st frame
+            (length,) = struct.unpack_from("<Q", data, pos)
+            pos += 16 + length
+        (length,) = struct.unpack_from("<Q", data, pos)
+        data[pos + 12 + length] ^= 0xFF
+        open(path, "wb").write(bytes(data))
+
+        def emit(native_assembly):
+            pipe = pipeline.CtrPipeline(
+                golden_files, field_size=7, batch_size=64, num_epochs=1,
+                shuffle=True, shuffle_files=True, shuffle_buffer=300,
+                drop_remainder=True, seed=9, verify_crc=True,
+                on_bad_record="skip", max_bad_records=5,
+                native_assembly=native_assembly)
+            h = hashlib.sha256()
+            for rows, m, n_ex in pipe.iter_superbatches(4):
+                h.update(str(m).encode())
+                h.update(rows["feat_ids"].tobytes())
+                h.update(rows["label"].tobytes())
+            return h.hexdigest(), pipe.health.bad_records
+
+        h_fused, bad_fused = emit(True)
+        h_fall, bad_fall = emit(False)
+        assert bad_fused == 1  # the corrupt record was actually hit
+        assert bad_fall == 1
+        assert h_fused == h_fall
+
 
 class TestAssembleBatchDeque:
     """_assemble_batch runs on a deque (O(1) front pops); emission must be

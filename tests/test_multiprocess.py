@@ -18,8 +18,11 @@ import pytest
 from deepfm_tpu.data import libsvm
 
 # Every test here spawns a real 2-process jax.distributed cluster on the CPU
-# backend; gated on the conftest cross-process-collectives probe.
-pytestmark = pytest.mark.mp_collectives
+# backend; gated on the conftest cross-process-collectives probe. Also
+# `slow`: each cluster pays two interpreter+jax cold starts plus a
+# rendezvous, minutes per test on a 1-core host — run with `-m slow`
+# (tier 2, see README "Running the tests").
+pytestmark = [pytest.mark.mp_collectives, pytest.mark.slow]
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

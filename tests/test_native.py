@@ -207,3 +207,138 @@ class TestDecodeSpansScatterValidation:
         loader.decode_spans_scatter(
             buf, np.empty(0, np.int64), np.empty(0, np.int64), 7,
             np.empty(0, np.int64), labels, ids, vals)
+
+
+class TestAssembleSpans:
+    """Fused multi-chunk decode->assemble (``dfm_decode_ctr_assemble``): one
+    GIL-released C call scattering every chunk's records into permuted rows
+    of the transfer-layout pool. Must be bit-identical to both the pure-
+    Python mirror and the per-chunk scatter path it replaces."""
+
+    def _jobs(self, sample_file, n_chunks=3, per=10, rows=None, rng_seed=3):
+        """Split the first n_chunks*per spans into chunks with a permuted
+        destination vector spanning all of them."""
+        buf = open(sample_file, "rb").read()
+        offsets, lengths = loader.split_frames(buf)
+        total = n_chunks * per
+        rows = total if rows is None else rows
+        dest_all = np.random.default_rng(rng_seed).permutation(total)
+        jobs = []
+        for c in range(n_chunks):
+            s = slice(c * per, (c + 1) * per)
+            jobs.append((buf, offsets[s], lengths[s],
+                         dest_all[s].astype(np.int64)))
+        return buf, jobs, dest_all, total
+
+    def _pools(self, rows, label_2d=False):
+        lab_shape = (rows, 1) if label_2d else rows
+        return (np.zeros(lab_shape, np.float32),
+                np.zeros((rows, 7), np.int32), np.zeros((rows, 7), np.float32))
+
+    @pytest.mark.skipif(not loader.has_assemble(),
+                        reason="stale .so without fused entry")
+    def test_matches_python_mirror_multichunk(self, sample_file):
+        _, jobs, dest_all, total = self._jobs(sample_file)
+        l_c, i_c, v_c = self._pools(total, label_2d=True)
+        loader.assemble_spans(jobs, 7, l_c, i_c, v_c)
+        l_p, i_p, v_p = self._pools(total, label_2d=True)
+        loader.assemble_spans_python(jobs, 7, l_p, i_p, v_p)
+        assert l_c.tobytes() == l_p.tobytes()
+        assert i_c.tobytes() == i_p.tobytes()
+        assert v_c.tobytes() == v_p.tobytes()
+        # and against the in-order gather decode, un-permuted
+        recs = tfrecord.read_all_records(sample_file)[:total]
+        l_ref, i_ref, v_ref = loader.decode_batch(recs, 7)
+        np.testing.assert_array_equal(l_c.reshape(-1)[dest_all], l_ref)
+        np.testing.assert_array_equal(i_c[dest_all], i_ref)
+        np.testing.assert_array_equal(v_c[dest_all], v_ref)
+
+    @pytest.mark.skipif(not loader.has_assemble(),
+                        reason="stale .so without fused entry")
+    def test_label_column_1d_and_2d_identical(self, sample_file):
+        """[P] and [P, 1] float32 label buffers are the same contiguous
+        memory; the fused entry must accept both (the drain passes the
+        transfer-layout [P, 1] column)."""
+        _, jobs, _, total = self._jobs(sample_file, n_chunks=2)
+        l1, i1, v1 = self._pools(total, label_2d=False)
+        loader.assemble_spans(jobs, 7, l1, i1, v1)
+        l2, i2, v2 = self._pools(total, label_2d=True)
+        loader.assemble_spans(jobs, 7, l2, i2, v2)
+        assert l1.tobytes() == l2.tobytes()
+        assert i1.tobytes() == i2.tobytes()
+
+    def test_dest_length_mismatch_raises(self, sample_file):
+        buf, jobs, _, total = self._jobs(sample_file, n_chunks=1)
+        labels, ids, vals = self._pools(total)
+        bad = [(buf, jobs[0][1], jobs[0][2], jobs[0][3][:-1])]
+        with pytest.raises(ValueError, match="len\\(dest\\)"):
+            loader.assemble_spans(bad, 7, labels, ids, vals)
+        with pytest.raises(ValueError, match="len\\(dest\\)"):
+            loader.assemble_spans_python(bad, 7, labels, ids, vals)
+
+    def test_dest_out_of_bounds_raises(self, sample_file):
+        buf, jobs, _, total = self._jobs(sample_file, n_chunks=2)
+        labels, ids, vals = self._pools(total)
+        dest = jobs[1][3].copy()
+        dest[0] = total  # one past the end of the pool
+        bad = [jobs[0], (buf, jobs[1][1], jobs[1][2], dest)]
+        with pytest.raises(ValueError, match="dest range"):
+            loader.assemble_spans(bad, 7, labels, ids, vals)
+        with pytest.raises(ValueError, match="dest range"):
+            loader.assemble_spans_python(bad, 7, labels, ids, vals)
+
+    def test_bounds_use_smallest_pool_array(self, sample_file):
+        _, jobs, _, total = self._jobs(sample_file, n_chunks=1)
+        labels = np.zeros(total, np.float32)
+        ids = np.zeros((total, 7), np.int32)
+        vals = np.zeros((total - 1, 7), np.float32)  # one row short
+        with pytest.raises(ValueError, match="dest range"):
+            loader.assemble_spans(jobs, 7, labels, ids, vals)
+
+    @pytest.mark.skipif(not loader.has_assemble(),
+                        reason="stale .so without fused entry")
+    def test_corruption_reports_chunk_and_record(self, sample_file):
+        """A record that fails protobuf parsing must surface the CHUNK index
+        and the chunk-local RECORD index (the -(100+i) / err_chunk
+        contract), so an operator can locate the bad bytes in a multi-chunk
+        drain."""
+        buf, jobs, _, total = self._jobs(sample_file, n_chunks=2)
+        labels, ids, vals = self._pools(total)
+        # chunk 1, record 3: point its span at garbage bytes (a CRC header
+        # region is not a valid Example payload)
+        offsets = jobs[1][1].copy()
+        offsets[3] = 0  # file offset 0 is the first frame's length header
+        bad = [jobs[0], (buf, offsets, jobs[1][2], jobs[1][3])]
+        with pytest.raises(ValueError, match=r"record 3 of chunk 1"):
+            loader.assemble_spans(bad, 7, labels, ids, vals)
+
+    def test_empty_jobs_noop(self):
+        loader.assemble_spans([], 7, np.empty(0, np.float32),
+                              np.empty((0, 7), np.int32),
+                              np.empty((0, 7), np.float32))
+
+    @pytest.mark.skipif(not loader.has_assemble(),
+                        reason="stale .so without fused entry")
+    def test_stale_so_falls_back_per_chunk(self, sample_file, monkeypatch):
+        """A cached .so predating the fused entry must degrade to the
+        per-chunk scatter path with identical bytes (the has_assemble()
+        probe contract)."""
+        real = loader._load()
+
+        class _StaleLib:
+            def __getattr__(self, name):
+                if name == "dfm_decode_ctr_assemble":
+                    raise AttributeError(name)
+                return getattr(real, name)
+
+        _, jobs, _, total = self._jobs(sample_file)
+        l_f, i_f, v_f = self._pools(total, label_2d=True)
+        loader.assemble_spans(jobs, 7, l_f, i_f, v_f)  # fused
+        stale = _StaleLib()
+        monkeypatch.setattr(loader, "_load", lambda: stale)
+        assert not loader.has_assemble()
+        l_s, i_s, v_s = self._pools(total, label_2d=True)
+        loader.assemble_spans(jobs, 7, l_s, i_s, v_s)  # per-chunk fallback
+        assert l_f.tobytes() == l_s.tobytes()
+        assert i_f.tobytes() == i_s.tobytes()
+        assert v_f.tobytes() == v_s.tobytes()

@@ -127,6 +127,7 @@ def _run_single_proc(args, timeout=420):
 
 
 @pytest.mark.mp_collectives
+@pytest.mark.slow
 def test_ragged_train_and_eval(ragged_workdir):
     """File-mode train over 96/64-record shards + eval over a 65-record set
     whose per-rank batch counts differ (2 vs 1). Pre-round-3: deadlock."""
@@ -162,6 +163,7 @@ def test_ragged_train_and_eval(ragged_workdir):
 
 
 @pytest.mark.mp_collectives
+@pytest.mark.slow
 def test_ragged_throttled_eval(ragged_workdir):
     """train_and_evaluate semantics on ragged shards: the mid-train eval
     hook broadcasts the chief's clock verdict at agreed dispatch counts —
@@ -183,6 +185,7 @@ def test_ragged_throttled_eval(ragged_workdir):
 
 
 @pytest.mark.mp_collectives
+@pytest.mark.slow
 def test_multiprocess_preemption_resume(ragged_workdir):
     """Cluster-wide fault injection (DEEPFM_TPU_FAULT_AFTER_STEPS) kills
     both ranks mid-epoch after an interval checkpoint; rerunning the same
@@ -219,6 +222,7 @@ def test_multiprocess_preemption_resume(ragged_workdir):
 
 
 @pytest.mark.mp_collectives
+@pytest.mark.slow
 def test_ragged_streaming_train(ragged_workdir):
     """Pipe-mode analog on the same unbalanced shards: the producer-side
     epoch replay makes rank0 see 6 batches and rank1 4; fit must stop both
