@@ -371,6 +371,75 @@ def device_resident_series() -> dict:
         }
 
 
+def online_publish_series() -> dict:
+    """Hot-publishing interference: ex/s of the same pre-staged dispatch
+    loop with the Publisher hook active vs absent (the <5% acceptance bar
+    from docs/TUNING.md §2.9), plus publish latency p50/p99 and worst-case
+    artifact staleness. The hook's synchronous cost is the device->host
+    params snapshot; the artifact write itself runs on the async executor,
+    so on a real TPU it overlaps device compute (on a 1-core CPU host the
+    background export steals the only core and the overhead reads high)."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from deepfm_tpu.train import Trainer
+    from deepfm_tpu.train.publish import Publisher
+
+    cfg = _bench_cfg()
+    trainer = Trainer(cfg)
+    state = trainer.init_state()
+    sb = [trainer.put_superbatch(g) for g in _make_groups(cfg, 4)]
+    step = trainer.multi_step
+    state, m = step(state, sb[0])  # compile
+    jax.block_until_ready(m["loss"])
+
+    def run(publisher):
+        nonlocal state
+        dt = float("inf")
+        steps = 0
+        for _ in range(N_TRIALS):
+            t0 = time.perf_counter()
+            for i in range(N_DISPATCH):
+                state, m = step(state, sb[i % 4])
+                steps += K_STEPS
+                if publisher is not None:
+                    publisher.maybe_publish(state, steps)
+            jax.block_until_ready(m["loss"])
+            dt = min(dt, time.perf_counter() - t0)
+        return N_DISPATCH * K_STEPS * cfg.batch_size / dt
+
+    off_eps = run(None)
+    tmp = tempfile.mkdtemp(prefix="bench_publish_")
+    try:
+        # ~3 cadence crossings per trial; in-flight skips (counted below)
+        # are the expected steady state when the export outlasts the
+        # interval, exactly as in production short-cadence configs.
+        pub = Publisher(trainer.model, cfg, tmp,
+                        every_steps=N_DISPATCH * K_STEPS // 3)
+        on_eps = run(pub)
+        pub.close()
+        stats = pub.stats()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "publish_off_ex_per_s": round(off_eps, 1),
+        "publish_on_ex_per_s": round(on_eps, 1),
+        "online_publish_overhead_pct": round(
+            100.0 * (1.0 - on_eps / max(off_eps, 1e-9)), 2),
+        "publish_count": stats["publish_count"],
+        "publish_skipped_inflight": stats["publish_skipped_inflight"],
+        "publish_latency_p50_s": (
+            round(stats["publish_latency_p50_s"], 3)
+            if stats["publish_latency_p50_s"] is not None else None),
+        "publish_latency_p99_s": (
+            round(stats["publish_latency_p99_s"], 3)
+            if stats["publish_latency_p99_s"] is not None else None),
+        "publish_staleness_steps_max": stats["publish_staleness_steps_max"],
+    }
+
+
 def pallas_ab_device_ratio() -> dict:
     """Interleaved Pallas-vs-XLA A/B over the device-only staged multi-step
     (no transfer inside the timed window) — the regression canary for the
@@ -558,6 +627,12 @@ def main() -> None:
         print(f"bench: device-resident series error: {e}", file=sys.stderr)
         device_resident = {"error": str(e)}
 
+    try:
+        online_publish = online_publish_series()
+    except Exception as e:
+        print(f"bench: online publish series error: {e}", file=sys.stderr)
+        online_publish = {"error": str(e)}
+
     nominal_per_accel_baseline = 250_000.0 / 4.0
     # MFU from the device-only series (no transfer in the window): model
     # FLOPs/example x device-only examples/sec/chip over the chip's dense
@@ -593,6 +668,7 @@ def main() -> None:
         "host_series": host_series,
         "pallas_ab_device": pallas_ab,
         "device_resident": device_resident,
+        "online_publish": online_publish,
         "pallas_smoke": pallas_smoke,
     }
     if scaling is not None:

@@ -138,6 +138,31 @@ class Config:
     # Warn + count when |loss - EMA| exceeds this many EMA std-devs
     # (after warmup). Advisory only; 0 disables.
     loss_spike_zscore: float = 0.0
+    # ---- online training & hot publishing (README "Online training") ----
+    # Continuous training: the train channel is an UNBOUNDED stream — a
+    # directory (or manifest file) that keeps receiving TFRecord shards
+    # (data/stream.py tails it; a high-water-mark sidecar in model_dir
+    # makes restarts replay-exact). Requires pipe_mode=1. The run ends on
+    # SIGTERM (exit 42, resumable) or after stream_idle_timeout_secs
+    # without new data.
+    online_mode: bool = False
+    # Publish a servable artifact (delta params checkpoint + export) every
+    # N steps / secs into publish_dir (default: <model_dir>/publish),
+    # atomically, off the training hot path. 0 disables that cadence.
+    publish_every_steps: int = 0
+    publish_every_secs: float = 0.0
+    publish_dir: str = ""
+    # A publish still in flight after this long trips the watchdog (exit
+    # 43) — same contract as dispatch_timeout_s. 0 disables.
+    publish_timeout_s: float = 600.0
+    # Sliding eval window for the online AUC: slices older than this many
+    # steps are evicted. 0 = cumulative (never evict).
+    online_eval_window_steps: int = 0
+    # Stream watcher cadence: how often the source is re-listed for new
+    # shards, and how long with no new data before the stream reports EOF
+    # (0 = wait forever; stop with SIGTERM).
+    stream_poll_secs: float = 2.0
+    stream_idle_timeout_secs: float = 0.0
 
     # ---- mesh / parallelism (replaces TF_CONFIG + horovod knobs) ----
     mesh_data: int = 0                # data-parallel axis size (0 = all devices)
@@ -221,6 +246,23 @@ class Config:
             raise ValueError("dispatch_timeout_s must be >= 0")
         if self.loss_spike_zscore < 0:
             raise ValueError("loss_spike_zscore must be >= 0")
+        if self.publish_every_steps < 0 or self.publish_every_secs < 0:
+            raise ValueError("publish_every_steps/secs must be >= 0")
+        if self.publish_timeout_s < 0:
+            raise ValueError("publish_timeout_s must be >= 0")
+        if self.online_eval_window_steps < 0:
+            raise ValueError("online_eval_window_steps must be >= 0")
+        if self.stream_poll_secs <= 0:
+            raise ValueError("stream_poll_secs must be > 0")
+        if self.stream_idle_timeout_secs < 0:
+            raise ValueError("stream_idle_timeout_secs must be >= 0")
+        if self.online_mode and self.pipe_mode != 1:
+            raise ValueError(
+                "online_mode requires pipe_mode=1 (the unbounded stream "
+                "source is a streaming-mode producer)")
+        if self.online_mode and self.num_epochs != 1:
+            raise ValueError(
+                "online_mode streams each shard once; num_epochs must be 1")
         if self.decoded_cache not in ("off", "ram", "disk"):
             raise ValueError(
                 f"decoded_cache must be off|ram|disk, got "

@@ -148,6 +148,58 @@ def rmtree(path: str) -> None:
     shutil.rmtree(path)
 
 
+def replace(src: str, dst: str) -> None:
+    """Atomically move ``src`` over ``dst`` (file or directory).
+
+    Local paths use ``os.replace`` — atomic on POSIX, so readers only ever
+    see the old artifact or the complete new one, never a partial state.
+    Remote stores rename with overwrite; object-store renames are not
+    guaranteed atomic, which is why the publish path requires a local
+    staging filesystem (see train/publish.py)."""
+    if is_remote(src) or is_remote(dst):
+        _gfile().rename(src, dst, overwrite=True)
+        return
+    os.replace(src, dst)
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a local directory so a just-completed rename survives a crash.
+    No-op for remote stores (durability is the store's contract)."""
+    if is_remote(path):
+        return
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def write_atomic(path: str, data) -> None:
+    """Write ``data`` (str or bytes) so readers see the old content or the
+    new content, never a torn intermediate: write a same-directory temp
+    file, flush+fsync, then rename over the destination. The pattern behind
+    every pointer/sidecar file the online-publishing path maintains
+    (``LATEST``, the stream high-water-mark manifest)."""
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    if is_remote(path):
+        # Remote stores: single-shot object write is already all-or-nothing.
+        with open_stream(path, "wb") as f:
+            f.write(data)
+        return
+    d = os.path.dirname(path) or "."
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    fsync_dir(d)
+
+
 def join(base: str, *parts: str) -> str:
     """Path join that keeps URL-style separators for remote bases.
 

@@ -15,6 +15,11 @@ import threading
 from typing import Dict, Optional
 
 
+SCALAR_COUNTERS = ("read_retries", "bad_records", "truncated_tails",
+                   "bytes_discarded", "late_files", "duplicate_files",
+                   "torn_files")
+
+
 class DataHealth:
     """Thread-safe counters for I/O faults survived by the pipeline."""
 
@@ -24,6 +29,13 @@ class DataHealth:
         self.bad_records = 0         # corrupt records skipped
         self.truncated_tails = 0     # files whose tail was discarded
         self.bytes_discarded = 0     # payload bytes dropped with bad frames
+        # Unbounded-stream-source counters (data/stream.py): shards that
+        # arrived sorting before already-consumed ones (admitted anyway),
+        # shards whose name was already consumed (skipped), and shards that
+        # vanished or shrank mid-read (partial tail discarded, stream heals).
+        self.late_files = 0
+        self.duplicate_files = 0
+        self.torn_files = 0
         self.per_file: Dict[str, Dict[str, int]] = {}
         self._dirty = False
 
@@ -50,6 +62,23 @@ class DataHealth:
             self._file(path)["skipped"] += 1
             self._dirty = True
 
+    def record_late_file(self, path: str) -> None:
+        with self._lock:
+            self.late_files += 1
+            self._dirty = True
+
+    def record_duplicate_file(self, path: str) -> None:
+        with self._lock:
+            self.duplicate_files += 1
+            self._dirty = True
+
+    def record_torn_file(self, path: str, nbytes: int = 0) -> None:
+        with self._lock:
+            self.torn_files += 1
+            self.bytes_discarded += int(nbytes)
+            self._file(path)["skipped"] += 1
+            self._dirty = True
+
     @property
     def total_events(self) -> int:
         with self._lock:
@@ -57,13 +86,10 @@ class DataHealth:
 
     def snapshot(self) -> Dict[str, object]:
         with self._lock:
-            return {
-                "read_retries": self.read_retries,
-                "bad_records": self.bad_records,
-                "truncated_tails": self.truncated_tails,
-                "bytes_discarded": self.bytes_discarded,
-                "per_file": {k: dict(v) for k, v in self.per_file.items()},
-            }
+            out: Dict[str, object] = {k: getattr(self, k)
+                                      for k in SCALAR_COUNTERS}
+            out["per_file"] = {k: dict(v) for k, v in self.per_file.items()}
+            return out
 
     def apply_delta(self, delta: Dict[str, object]) -> None:
         """Add a snapshot-shaped increment into these counters — the
@@ -72,8 +98,7 @@ class DataHealth:
         so restransmission-free aggregation stays exact)."""
         with self._lock:
             changed = False
-            for key in ("read_retries", "bad_records", "truncated_tails",
-                        "bytes_discarded"):
+            for key in SCALAR_COUNTERS:
                 inc = int(delta.get(key, 0))  # type: ignore[arg-type]
                 if inc:
                     setattr(self, key, getattr(self, key) + inc)
@@ -88,8 +113,7 @@ class DataHealth:
     def merge_into(self, totals: Dict[str, int]) -> None:
         """Accumulate scalar counters into ``totals`` (for cross-epoch sums)."""
         snap = self.snapshot()
-        for key in ("read_retries", "bad_records", "truncated_tails",
-                    "bytes_discarded"):
+        for key in SCALAR_COUNTERS:
             totals[key] = totals.get(key, 0) + int(snap[key])  # type: ignore[arg-type]
 
     def summary(self) -> str:
@@ -100,11 +124,11 @@ class DataHealth:
         files = ", ".join(
             f"{p}(retries={c['retries']},skipped={c['skipped']})"
             for p, c in worst)
-        return (f"read_retries={snap['read_retries']} "
-                f"bad_records={snap['bad_records']} "
-                f"truncated_tails={snap['truncated_tails']} "
-                f"bytes_discarded={snap['bytes_discarded']}"
-                + (f" [{files}]" if files else ""))
+        scalars = " ".join(f"{k}={snap[k]}" for k in SCALAR_COUNTERS
+                           if k in ("read_retries", "bad_records",
+                                    "truncated_tails", "bytes_discarded")
+                           or snap[k])
+        return scalars + (f" [{files}]" if files else "")
 
     def consume_dirty(self) -> bool:
         """True once per batch of new events — drives log_steps-cadence logs."""

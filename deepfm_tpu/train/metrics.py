@@ -90,6 +90,82 @@ def mean_compute(state: MeanState) -> jnp.ndarray:
     return state.total / jnp.maximum(state.count, 1.0)
 
 
+class WindowedAuc:
+    """Sliding-window streaming AUC for the online trainer.
+
+    A batch job evaluates once over a held-out set; a job that trains for
+    weeks needs "AUC over the last N steps of traffic" instead. Each
+    :meth:`update` bins one eval slice into the same pos/neg histograms as
+    :func:`auc_update` (host-side numpy — eval slices arrive as host arrays
+    off the predict path) and tags it with the training step; slices older
+    than ``window_steps`` are evicted. The window aggregate is therefore a
+    histogram pair — additive, so multi-process reduction stays a plain
+    psum/allreduce over ``histograms`` before :meth:`compute`, exactly like
+    the batch AUC (SURVEY.md hard-part #2).
+    """
+
+    def __init__(self, window_steps: int, num_bins: int = 200):
+        if window_steps <= 0:
+            raise ValueError(f"window_steps must be > 0, got {window_steps}")
+        self.window_steps = int(window_steps)
+        self.num_bins = int(num_bins)
+        from collections import deque
+        self._slices = deque()  # (step, pos_hist, neg_hist) np.float64
+        self._pos = None  # running window sums (lazy numpy import pattern)
+        self._neg = None
+        self.examples = 0  # examples currently inside the window
+
+    def _hist(self, probs, labels):
+        import numpy as np
+        probs = np.asarray(probs, dtype=np.float64).reshape(-1)
+        labels = np.asarray(labels, dtype=np.float64).reshape(-1)
+        bins = np.clip((probs * self.num_bins).astype(np.int64),
+                       0, self.num_bins - 1)
+        pos = np.bincount(bins, weights=labels, minlength=self.num_bins)
+        neg = np.bincount(bins, weights=1.0 - labels,
+                          minlength=self.num_bins)
+        return pos, neg
+
+    def update(self, step: int, probs, labels) -> None:
+        """Fold one eval slice (taken at training ``step``) into the window."""
+        import numpy as np
+        pos, neg = self._hist(probs, labels)
+        if self._pos is None:
+            self._pos = np.zeros((self.num_bins,), np.float64)
+            self._neg = np.zeros((self.num_bins,), np.float64)
+        self._slices.append((int(step), pos, neg))
+        self._pos += pos
+        self._neg += neg
+        self.examples += int(pos.sum() + neg.sum())
+        self.evict(int(step))
+
+    def evict(self, current_step: int) -> None:
+        """Drop slices taken more than ``window_steps`` before ``current_step``."""
+        floor = int(current_step) - self.window_steps
+        while self._slices and self._slices[0][0] <= floor:
+            _, pos, neg = self._slices.popleft()
+            self._pos -= pos
+            self._neg -= neg
+            self.examples -= int(pos.sum() + neg.sum())
+
+    def histograms(self):
+        """(pos, neg) window-aggregate histograms — reduce these across
+        processes (psum/allreduce) before :meth:`compute` for a global AUC."""
+        import numpy as np
+        if self._pos is None:
+            z = np.zeros((self.num_bins,), np.float64)
+            return z, z.copy()
+        return self._pos.copy(), self._neg.copy()
+
+    def compute(self, histograms=None) -> float:
+        """Windowed AUC (same trapezoidal estimator as :func:`auc_compute`);
+        0.0 while the window lacks both classes, mirroring the batch path."""
+        pos, neg = self.histograms() if histograms is None else histograms
+        return float(auc_compute(AucState(
+            pos=jnp.asarray(pos, jnp.float32),
+            neg=jnp.asarray(neg, jnp.float32))))
+
+
 def auc_numpy_reference(probs, labels) -> float:
     """Exact (rank-based) AUC on host — test oracle for the binned estimator."""
     import numpy as np

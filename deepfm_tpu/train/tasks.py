@@ -33,6 +33,7 @@ from ..data import cache as cache_lib
 from ..data import fileio
 from ..data import pipeline as pipe_lib
 from ..data import sharding as shard_lib
+from ..data import stream as stream_lib
 from ..parallel import bootstrap
 from ..utils import checkpoint as ckpt_lib
 from ..utils import export as export_lib
@@ -42,6 +43,8 @@ from ..utils import preempt as preempt_lib
 from ..utils import profiling as prof_lib
 from ..utils import retry as retry_lib
 from . import guard as guard_lib
+from . import metrics as metrics_lib
+from . import publish as publish_lib
 from .loop import Trainer, pad_batch
 from .state import TrainState
 
@@ -248,6 +251,58 @@ def make_streaming_pipeline(cfg: Config, files: List[str], *, epochs: int = 1,
     )
 
 
+# High-water-mark sidecar for the online stream source, next to the
+# checkpoints it must stay consistent with.
+_STREAM_SIDECAR = "stream_manifest.json"
+
+# Stable files-digest sentinel for online mode: the live directory listing
+# grows by design, so the resume gate cannot fingerprint WHAT will be read —
+# the stream's high-water-mark sidecar carries that contract instead, and
+# this constant keeps the resume_meta digest comparison from spuriously
+# invalidating a perfectly replayable skip.
+_ONLINE_FILES_DIGEST = "online-stream-v1"
+
+
+def make_online_pipeline(cfg: Config, train_dir: str, *, skip_batches: int = 0
+                         ) -> Tuple[pipe_lib.StreamingCtrPipeline,
+                                    stream_lib.UnboundedFileStream]:
+    """Unbounded-stream producer for ``--online_mode``: the watcher tails
+    ``tr*.tfrecords`` under ``train_dir`` (see data/stream.py for the
+    admission/heal protocol) and the unchanged streaming consumer decodes
+    it. Returns (pipeline, stream) — the stream handle lets the preemption
+    path wake a blocked poll wait. Single-process for now: multi-process
+    online mode needs chief-coordinated admission so every rank replays the
+    same order (ROADMAP item 1's serving work is the priority first)."""
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "online_mode is single-process for now: shard admission order "
+            "must be chief-coordinated before ranks can record-shard an "
+            "unbounded stream consistently")
+    health = pipe_lib.DataHealth()
+    sidecar = (fileio.join(cfg.model_dir, _STREAM_SIDECAR)
+               if cfg.model_dir else "")
+    stream = stream_lib.UnboundedFileStream(
+        train_dir, pattern="tr*.tfrecords", sidecar_path=sidecar,
+        poll_secs=cfg.stream_poll_secs,
+        idle_timeout_secs=cfg.stream_idle_timeout_secs,
+        retry_policy=retry_lib.policy_from_config(cfg), health=health)
+    pipeline = pipe_lib.StreamingCtrPipeline(
+        stream,
+        field_size=cfg.field_size,
+        batch_size=_local_batch_size(cfg),
+        drop_remainder=cfg.drop_remainder,
+        prefetch_batches=cfg.prefetch_batches,
+        use_native_decoder=cfg.use_native_decoder,
+        skip_batches=skip_batches,
+        verify_crc=cfg.verify_crc,
+        on_bad_record=cfg.on_bad_record,
+        max_bad_records=cfg.max_bad_records,
+        stream_label=f"<online:{train_dir}>",
+        health=health,
+    )
+    return pipeline, stream
+
+
 def _fit_epoch(trainer: Trainer, cfg: Config, state: TrainState, pipeline,
                hooks, on_log, guard=None
                ) -> Tuple[TrainState, Dict[str, float]]:
@@ -314,6 +369,9 @@ def run(cfg: Config) -> Dict[str, float]:
     # Config-driven retry for every fileio op (glob/stat/open + the resume
     # sidecar reads) — not just the pipelines' own streams.
     fileio.set_retry_policy(retry_lib.policy_from_config(cfg))
+    # Drill seam: env-scripted read faults reach a LAUNCHED subprocess,
+    # where the in-process FlakyFS context manager can't (online_drill.py).
+    faults_lib.install_env_faults()
     ulog.info(
         f"task={cfg.task_type} model={cfg.model} processes="
         f"{jax.process_count()} devices={len(jax.devices())}")
@@ -388,6 +446,51 @@ def _make_throttled_eval_hook(trainer: Trainer, cfg: Config,
             on_eval(ev, state)
 
     return hook
+
+
+def _make_online_eval(trainer: Trainer, cfg: Config, va_files: List[str],
+                      window, step_fn):
+    """Online-mode evaluate fn: one predict pass over the held-out set,
+    folded into a sliding :class:`~deepfm_tpu.train.metrics.WindowedAuc`
+    tagged with the current training step — "AUC over the last N steps of
+    traffic" rather than the batch job's cumulative AUC. Single-process
+    (online mode is; see make_online_pipeline)."""
+    import time as _time
+
+    local_bs = _local_batch_size(cfg)
+
+    def evaluate(state: TrainState) -> Dict[str, float]:
+        pipeline = _eval_pipeline(cfg, va_files)
+        probs: List[np.ndarray] = []
+        labels: List[np.ndarray] = []
+        real_rows: List[int] = []
+        t0 = _time.time()
+
+        def feed():
+            for batch in pipeline:
+                n = batch["label"].shape[0]
+                real_rows.append(n)
+                labels.append(np.asarray(batch["label"]).reshape(-1)[:n])
+                yield (pad_batch(batch, local_bs)  # pad tail, trim after
+                       if n < local_bs else batch)
+
+        for i, p in enumerate(trainer.predict(state, feed())):
+            probs.append(np.asarray(p).reshape(-1)[:real_rows[i]])
+        elapsed = max(_time.time() - t0, 1e-9)
+        p = (np.concatenate(probs) if probs
+             else np.zeros((0,), np.float64)).astype(np.float64)
+        y = (np.concatenate(labels) if labels
+             else np.zeros((0,), np.float64)).astype(np.float64)
+        window.update(int(step_fn()), p, y)
+        pc = np.clip(p, 1e-7, 1.0 - 1e-7)
+        loss = (float(-(y * np.log(pc)
+                        + (1.0 - y) * np.log1p(-pc)).mean())
+                if len(y) else 0.0)
+        return {"auc": window.compute(), "loss": loss,
+                "examples_per_sec": len(y) / elapsed,
+                "window_examples": float(window.examples)}
+
+    return evaluate
 
 
 _RESUME_META = "resume_meta.json"
@@ -491,11 +594,15 @@ def _consumption_layout(cfg: Config) -> List[int]:
     # including it (a list-LENGTH change old sidecars can't match) makes a
     # resume across the flag fall back to epoch-replay rather than trusting
     # a fingerprint that never recorded which path ran.
+    # online_mode swaps the producer (finite file chain -> unbounded stream
+    # with its own admission order), so a resume across the flag must never
+    # trust a prior skip count — the list-LENGTH change guarantees that for
+    # sidecars written before the flag existed too.
     return [2, jax.process_count(), cfg.steps_per_loop,
             int(cfg.use_native_decoder), cfg.batch_size,
             cfg.shuffle_buffer, cfg.seed, int(cfg.drop_remainder),
             int(cfg.shuffle_files), cache_lib.MODES.index(cfg.decoded_cache),
-            int(cfg.native_assembly)]
+            int(cfg.native_assembly), int(cfg.online_mode)]
 
 
 def _resume_position(cfg: Config, restored_step: int,
@@ -579,7 +686,9 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
     train_dir, eval_dir = resolve_channel_dirs(cfg)
     tr_files = resolve_files(train_dir, "tr")
     va_files = resolve_files(eval_dir, "va")
-    if not tr_files:
+    if not tr_files and not cfg.online_mode:
+        # Online mode tails the directory: starting before the first shard
+        # arrives is the normal case, not an error.
         raise FileNotFoundError(f"no training tfrecords in {train_dir!r}")
     _validate_shard_coverage(cfg, tr_files)
     ulog.info(f"train dir={train_dir} files={len(tr_files)} "
@@ -617,8 +726,13 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
     # skip_batches) and desynchronize the lockstep collectives — a hang or
     # silent mis-training (ADVICE r4 high+medium). restored_step itself is
     # rank-consistent (all ranks restore the same global checkpoint).
-    files_digest = (_files_fingerprint(cfg, tr_files)
-                    if bootstrap.is_chief() else "")
+    files_digest = ""
+    if bootstrap.is_chief():
+        # Online mode: the listing grows by design — a stable sentinel keeps
+        # the resume gate from invalidating a replayable skip; the stream
+        # sidecar (not the digest) carries WHAT-will-be-read exactness.
+        files_digest = (_ONLINE_FILES_DIGEST if cfg.online_mode
+                        else _files_fingerprint(cfg, tr_files))
 
     def _resume_for(restored_step: int) -> Tuple[int, int, int]:
         if jax.process_count() > 1:
@@ -741,6 +855,29 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                     "layout": _consumption_layout(cfg),
                     "files": files_digest, "completed": completed}
 
+        # Online hot publishing: per attempt, so a rollback replay starts
+        # with a clean in-flight ledger (the publish DIR persists — already-
+        # published versions are skipped idempotently).
+        publisher = None
+        online_stream = [None]  # UnboundedFileStream handle for preempt wake
+        if cfg.online_mode and (cfg.publish_every_steps
+                                or cfg.publish_every_secs):
+            pdir = cfg.publish_dir or (
+                fileio.join(cfg.model_dir, "publish") if cfg.model_dir
+                else "")
+            if not pdir:
+                raise ValueError("--publish_every_steps/secs needs "
+                                 "--publish_dir or --model_dir")
+            publisher = publish_lib.Publisher(
+                trainer.model, cfg, pdir,
+                every_steps=cfg.publish_every_steps,
+                every_secs=cfg.publish_every_secs,
+                timeout_s=cfg.publish_timeout_s,
+                health=train_health)
+            # Resumed runs cross the same publish boundaries a fresh run
+            # would (the drill's version-set determinism rests on this).
+            publisher.seed_cadence(restored_step)
+
         hooks = []
         # Host-side step counter: reading s.step would force a device sync
         # every step (it blocks on the async-dispatched update), collapsing
@@ -749,6 +886,12 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
         step_counter = [restored_step]
         hooks.append(lambda s, m: step_counter.__setitem__(
             0, step_counter[0] + int(m.get("steps_done", 1))))
+
+        if publisher is not None:
+            # Cadence check + host snapshot + async submit; never blocks on
+            # publish I/O. Also the wedged-publish watchdog (exit 43).
+            hooks.append(lambda s, m: publisher.maybe_publish(
+                s, step_counter[0]))
 
         last_saved = [-1]
         if mgr is not None:
@@ -816,6 +959,13 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                 # position replay-exact on restart.
                 mgr.save(step, s, force=True)
                 _write_resume_meta(cfg.model_dir, _meta(step, False))
+            if online_stream[0] is not None:
+                online_stream[0].request_stop()  # wake a blocked poll wait
+            if publisher is not None:
+                # Drain the in-flight publish before exit 42: a published
+                # artifact must never be abandoned half-staged by a graceful
+                # preemption (a wedged one still trips the 43 watchdog).
+                publisher.drain(timeout=cfg.publish_timeout_s or None)
             raise preempt_lib.Preempted(step, listener.reason)
         hooks.append(preempt_hook)
 
@@ -830,10 +980,22 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
         tracer = prof_lib.StepWindowTracer(
             cfg.profile_dir, num_steps=cfg.profile_steps)
         hooks.append(lambda s, m: tracer.on_step(int(m.get("steps_done", 1))))
+        # Online windowed eval: the throttled-eval machinery drives WHEN;
+        # the evaluate override swaps the cumulative batch AUC for the
+        # sliding-window streaming AUC (metrics.WindowedAuc).
+        online_eval_fn = None
+        if (cfg.online_mode and va_files
+                and cfg.online_eval_window_steps > 0):
+            window = metrics_lib.WindowedAuc(
+                cfg.online_eval_window_steps,
+                num_bins=cfg.auc_num_thresholds)
+            online_eval_fn = _make_online_eval(
+                trainer, cfg, va_files, window, lambda: step_counter[0])
         if eval_throttled:
             hooks.append(_make_throttled_eval_hook(
                 trainer, cfg, va_files, result, on_eval=_tb_eval,
-                evaluate=lambda s: _run_eval(s, "throttled eval")))
+                evaluate=(online_eval_fn
+                          or (lambda s: _run_eval(s, "throttled eval")))))
         try:
             if cfg.pipe_mode:
                 # Streaming (Pipe-mode analog): ONE train call consuming a
@@ -842,9 +1004,17 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                 # FIFO not reusable per epoch). Eval afterwards, file-mode.
                 # Resume: the already-trained stream prefix is skipped
                 # (epoch index stays 0 — position is steps into the stream).
-                pipeline = _maybe_poison(make_streaming_pipeline(
-                    cfg, tr_files, epochs=cfg.num_epochs,
-                    skip_batches=skip_batches, epoch_offset=epoch_base))
+                # online_mode swaps the finite file chain for the unbounded
+                # directory watcher; the consumer is identical.
+                if cfg.online_mode:
+                    pipeline, ustream = make_online_pipeline(
+                        cfg, train_dir, skip_batches=skip_batches)
+                    online_stream[0] = ustream
+                    pipeline = _maybe_poison(pipeline)
+                else:
+                    pipeline = _maybe_poison(make_streaming_pipeline(
+                        cfg, tr_files, epochs=cfg.num_epochs,
+                        skip_batches=skip_batches, epoch_offset=epoch_base))
                 state, fit_m = trainer.fit(state, pipeline, hooks=hooks,
                                            on_log=_tb_log, guard=guard)
                 _log_health(pipeline, "stream end")
@@ -853,13 +1023,29 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                     result["loss"] = fit_m["loss"]
                     result["examples_per_sec"] = fit_m.get(
                         "examples_per_sec", 0.0)
+                if publisher is not None:
+                    # Stream ended (idle timeout / stop): force one final
+                    # publish at the terminal step. Deterministic — both an
+                    # interrupted-and-resumed run and a clean run end at the
+                    # same step over the same admitted shards, so the drill
+                    # always has a common version to bit-compare.
+                    publisher.drain(timeout=cfg.publish_timeout_s or None)
+                    final_step = step_counter[0]
+                    if final_step and final_step not in publisher.published:
+                        publisher.publish_now(state, final_step)
+                        publisher.drain(
+                            timeout=cfg.publish_timeout_s or None)
+                    result.update(publisher.stats())
                 if va_files:
-                    ev = _run_eval(state, "stream eval")
+                    ev = (online_eval_fn(state) if online_eval_fn is not None
+                          else _run_eval(state, "stream eval"))
                     ulog.info(f"streaming train done: eval auc={ev['auc']:.5f} "
                               f"loss={ev['loss']:.5f}")
                     result.update({"auc": ev["auc"], "eval_loss": ev["loss"],
                                    "eval_examples_per_sec":
                                        ev["examples_per_sec"]})
+                    if "window_examples" in ev:  # online windowed AUC
+                        result["window_examples"] = ev["window_examples"]
                     _tb_eval(ev, state)
             else:
                 for epoch in range(start_epoch, cfg.num_epochs):
@@ -918,6 +1104,11 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                     _tb_eval(ev, state)
         finally:
             tracer.close()
+            if publisher is not None:
+                publisher.close()
+            if online_stream[0] is not None:
+                online_stream[0].close()
+                online_stream[0] = None
         if mgr is not None:
             final_step = int(state.step)
             mgr.save(final_step, state, force=True)

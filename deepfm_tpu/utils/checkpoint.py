@@ -12,7 +12,9 @@ training steps), and keeps ``max_to_keep`` checkpoints. Preemption tolerance
 
 from __future__ import annotations
 
-from typing import Any, Optional
+import concurrent.futures
+import threading
+from typing import Any, Callable, Optional
 
 import jax
 import orbax.checkpoint as ocp
@@ -20,6 +22,52 @@ import orbax.checkpoint as ocp
 from ..data import fileio
 from . import logging as ulog
 from . import retry as retry_lib
+
+
+class AsyncSaveExecutor:
+    """One background thread for artifact writes off the training hot path.
+
+    Orbax drives its own async checkpoint writes; this executor serializes
+    the *other* asynchronous writers — the online publisher's delta
+    checkpoint + servable export jobs — so publish I/O never competes with
+    itself and ``drain()`` gives the preemption path a single place to wait.
+    The thread is created lazily on first submit and is a daemon, so an
+    executor that is constructed but never used costs nothing and never
+    blocks interpreter exit.
+    """
+
+    def __init__(self, name: str = "async-save"):
+        self._name = name
+        self._lock = threading.Lock()
+        self._pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
+
+    def submit(self, fn: Callable, *args, **kwargs) -> concurrent.futures.Future:
+        with self._lock:
+            if self._pool is None:
+                self._pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix=self._name)
+            return self._pool.submit(fn, *args, **kwargs)
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Wait for all submitted jobs; True iff everything finished in time.
+        Submitting a no-op and waiting on it rides the FIFO guarantee of the
+        single worker thread, so no job bookkeeping is needed."""
+        with self._lock:
+            pool = self._pool
+        if pool is None:
+            return True
+        fence = pool.submit(lambda: None)
+        try:
+            fence.result(timeout=timeout)
+            return True
+        except concurrent.futures.TimeoutError:
+            return False
+
+    def close(self) -> None:
+        with self._lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=True)
 
 
 class CheckpointManager:

@@ -17,7 +17,9 @@ round-trip analog used by tests and the infer benchmark.
 from __future__ import annotations
 
 import json
-from typing import Any, Callable, Dict
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +35,22 @@ _SERVING_FILE = "serving_fn.stablehlo"
 _PARAMS_DIR = "params.ckpt"
 _CONFIG_FILE = "model_config.json"
 _SAVEDMODEL_DIR = "saved_model"
+
+# Written LAST by export_serving: its presence certifies every other file in
+# the artifact dir is complete. load_serving refuses dirs without it — a
+# crashed or in-flight export must fail with a typed error, not a cryptic
+# deserialization traceback halfway through restore.
+COMPLETE_MARKER = "ARTIFACT_COMPLETE"
+
+# Pointer file maintained next to published artifact dirs: its content is
+# the basename of the newest complete artifact. Updated via write_atomic so
+# readers only ever see a fully-published version.
+LATEST_FILE = "LATEST"
+
+
+class ArtifactIncomplete(RuntimeError):
+    """A servable artifact dir is missing its completion marker (export
+    crashed mid-write, or the caller raced an in-flight publish)."""
 
 
 def _serving_fn(model, cfg: Config) -> Callable:
@@ -112,6 +130,11 @@ def export_serving(model, state, cfg: Config, out_dir: str) -> str:
     }
     with fileio.open_stream(fileio.join(out_dir, _CONFIG_FILE), "w") as f:
         json.dump(meta, f, indent=2)
+
+    # 5. Completion marker — strictly last, atomically: the artifact is not
+    # loadable until every byte above it is on disk.
+    fileio.write_atomic(fileio.join(out_dir, COMPLETE_MARKER),
+                        json.dumps({"step": meta["step"]}))
     ulog.info(f"exported servable model to {out_dir}")
     return out_dir
 
@@ -175,7 +198,17 @@ def _export_tf_savedmodel(serve: Callable, params, model_state, cfg: Config,
 
 
 def load_serving(artifact_dir: str) -> Callable[[np.ndarray, np.ndarray], np.ndarray]:
-    """Reload a servable artifact as ``f(feat_ids, feat_vals) -> probs``."""
+    """Reload a servable artifact as ``f(feat_ids, feat_vals) -> probs``.
+
+    Raises :class:`ArtifactIncomplete` when the dir lacks its completion
+    marker — the dir is mid-write, or an export crashed into it. Callers
+    that poll (``watch_latest``) treat this as "try again later"; everything
+    else should treat it as a corrupt deployment.
+    """
+    if not fileio.exists(fileio.join(artifact_dir, COMPLETE_MARKER)):
+        raise ArtifactIncomplete(
+            f"{artifact_dir} has no {COMPLETE_MARKER} marker — the artifact "
+            "is incomplete (crashed or in-flight export); refusing to load")
     with fileio.open_stream(fileio.join(artifact_dir, _CONFIG_FILE), "r") as f:
         meta = json.load(f)
     cfg = Config.from_dict(meta["config"])
@@ -203,3 +236,111 @@ def load_serving(artifact_dir: str) -> Callable[[np.ndarray, np.ndarray], np.nda
     def serve(feat_ids: np.ndarray, feat_vals: np.ndarray) -> np.ndarray:
         return np.asarray(fn(params, model_state, feat_ids, feat_vals))
     return serve
+
+
+# --------------------------------------------------------------------------
+# LATEST pointer + hot-swap consumer
+# --------------------------------------------------------------------------
+
+def write_latest(publish_dir: str, version: str) -> None:
+    """Point ``<publish_dir>/LATEST`` at artifact dir ``version`` (basename).
+    Atomic: a crashed update leaves the previous pointer intact."""
+    fileio.write_atomic(fileio.join(publish_dir, LATEST_FILE), str(version))
+
+
+def read_latest(publish_dir: str) -> Optional[str]:
+    """Full path of the newest published artifact, or None when no pointer
+    exists yet (or it dangles — points at a dir that is gone)."""
+    pointer = fileio.join(publish_dir, LATEST_FILE)
+    if not fileio.exists(pointer):
+        return None
+    with fileio.open_stream(pointer, "rb") as f:
+        version = f.read().decode("utf-8").strip()
+    if not version:
+        return None
+    path = fileio.join(publish_dir, version)
+    return path if fileio.exists(path) else None
+
+
+class LatestWatcher:
+    """Hot-swap serving consumer: follow ``LATEST`` without dropping requests.
+
+    Callable with the same ``(feat_ids, feat_vals) -> probs`` signature as
+    :func:`load_serving`'s result. A poll (background thread, or
+    :meth:`check_once` for callers that drive it themselves) notices a new
+    ``LATEST`` pointer, loads the NEW artifact completely off to the side,
+    then swaps it in with one attribute assignment — requests in flight keep
+    executing the old function; requests after the swap get the new one; no
+    request ever observes a half-loaded model. A load failure (incomplete or
+    vanished artifact — e.g. the watcher raced a publish) keeps the current
+    model and retries next poll.
+    """
+
+    def __init__(self, publish_dir: str, *, poll_secs: float = 2.0,
+                 on_swap: Optional[Callable[[str], None]] = None,
+                 loader: Callable[[str], Callable] = load_serving,
+                 start: bool = True,
+                 sleep: Optional[Callable[[float], None]] = None):
+        self._publish_dir = publish_dir
+        self._poll_secs = float(poll_secs)
+        self._on_swap = on_swap
+        self._loader = loader
+        self._stop = threading.Event()
+        self._sleep = sleep if sleep is not None else self._stop.wait
+        self._fn: Optional[Callable] = None
+        self.current_path: Optional[str] = None
+        self.swap_count = 0
+        self._thread: Optional[threading.Thread] = None
+        self.check_once()
+        if start:
+            self._thread = threading.Thread(
+                target=self._run, name="latest-watcher", daemon=True)
+            self._thread.start()
+
+    def check_once(self) -> bool:
+        """Poll LATEST; swap if it moved. Returns True iff a swap happened."""
+        path = read_latest(self._publish_dir)
+        if path is None or path == self.current_path:
+            return False
+        try:
+            fn = self._loader(path)
+        except (ArtifactIncomplete, OSError, ValueError) as e:
+            ulog.warning(f"hot-swap to {path} deferred ({e}); "
+                         "keeping current model")
+            return False
+        self._fn = fn  # the swap: one reference assignment
+        self.current_path = path
+        self.swap_count += 1
+        if self._on_swap is not None:
+            self._on_swap(path)
+        return True
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._sleep(self._poll_secs)
+            if self._stop.is_set():
+                return
+            try:
+                self.check_once()
+            except Exception as e:  # never kill the serving thread
+                ulog.warning(f"LATEST poll failed ({e}); retrying")
+
+    def __call__(self, feat_ids: np.ndarray,
+                 feat_vals: np.ndarray) -> np.ndarray:
+        fn = self._fn
+        if fn is None:
+            raise RuntimeError(
+                f"no artifact published under {self._publish_dir} yet")
+        return fn(feat_ids, feat_vals)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+
+def watch_latest(publish_dir: str, **kwargs) -> LatestWatcher:
+    """``load_serving`` that follows the LATEST pointer: returns a callable
+    that hot-swaps to each newly published artifact without dropping a
+    request. See :class:`LatestWatcher` (kwargs forwarded)."""
+    return LatestWatcher(publish_dir, **kwargs)

@@ -92,6 +92,54 @@ class BatchPoisoner:
             yield batch
 
 
+# -- publish crash seam --------------------------------------------------
+#
+# One-shot registry consumed by train/publish.py: a test arms a crash at a
+# named stage of the publish sequence ("before_rename",
+# "after_rename_before_latest"); the publisher raises InjectedFault at that
+# exact point, simulating a process death mid-publish. The atomicity tests
+# then assert the LATEST pointer still resolves to the previous good
+# artifact and nothing half-written is visible.
+
+_publish_crash_lock = threading.Lock()
+_publish_crash: Optional[str] = None
+
+
+def set_publish_crash(stage: str) -> None:
+    """Arm a one-shot crash at publish stage ``stage`` (taken once)."""
+    global _publish_crash
+    with _publish_crash_lock:
+        _publish_crash = str(stage)
+
+
+def check_publish_crash(stage: str) -> None:
+    """Called by the publisher at each stage; raises iff armed for it."""
+    global _publish_crash
+    with _publish_crash_lock:
+        if _publish_crash != stage:
+            return
+        _publish_crash = None
+    raise InjectedFault(f"injected publish crash at stage {stage!r}")
+
+
+# Env seam for subprocess drills (scripts/online_drill.py): the train task
+# calls install_env_faults() at startup; with DEEPFM_TPU_READ_FAULT_EVERY=k
+# set, a process-wide FlakyFS making every k-th read fail once is installed,
+# so a *launched* online job heals scripted transient faults — the in-process
+# context-manager pattern can't reach a subprocess.
+READ_FAULT_ENV = "DEEPFM_TPU_READ_FAULT_EVERY"
+
+
+def install_env_faults() -> Optional["FlakyFS"]:
+    import os
+    every = int(os.environ.get(READ_FAULT_ENV, "0") or 0)
+    if every <= 0:
+        return None
+    fs = FlakyFS(read_fail_every=every)
+    fileio.set_fault_injector(fs)
+    return fs
+
+
 class FlakyStream(io.RawIOBase):
     """Read-stream wrapper raising scripted faults; otherwise transparent."""
 

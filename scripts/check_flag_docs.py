@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Fail if any ``Config`` field is undocumented in docs/MIGRATION.md.
+
+Every dataclass field of :class:`deepfm_tpu.config.Config` is a ``--flag``
+(argparse auto-generates the parser from the dataclass), and MIGRATION.md is
+the flag contract page — the one place a reference user looks up every knob.
+This check keeps the two from drifting: adding a Config field without a
+MIGRATION row breaks tier-1 (``tests/test_flag_docs.py`` wraps this).
+
+A field counts as documented if MIGRATION.md mentions it as ``--name`` or
+`` `name` `` (backticked).
+
+Usage: python scripts/check_flag_docs.py  (exit 0 = all documented)
+"""
+
+import dataclasses
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOC = os.path.join(REPO, "docs", "MIGRATION.md")
+
+
+def missing_flags(doc_text=None):
+    """Config field names not mentioned in MIGRATION.md."""
+    from deepfm_tpu.config import Config
+    if doc_text is None:
+        with open(DOC, encoding="utf-8") as f:
+            doc_text = f.read()
+    return [f.name for f in dataclasses.fields(Config)
+            if f"--{f.name}" not in doc_text
+            and f"`{f.name}`" not in doc_text]
+
+
+def main():
+    missing = missing_flags()
+    if missing:
+        print(f"docs/MIGRATION.md is missing {len(missing)} flag(s):")
+        for name in missing:
+            print(f"  --{name}")
+        print("add a row (as `--name` or backticked `name`) to "
+              "docs/MIGRATION.md")
+        return 1
+    print("all Config flags documented in docs/MIGRATION.md")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,268 @@
+#!/usr/bin/env python
+"""Online-training drill: faults + repeated SIGTERMs, artifact parity.
+
+The executable acceptance check for continuous online training with atomic
+hot publishing (``data/stream.py`` + ``train/publish.py`` + the online
+branch of the train task):
+
+  1. **Live online job under faults.** Launch a real ``deepfm_tpu.launch``
+     subprocess in ``--online_mode`` over a directory holding the first
+     half of the shards, with ``DEEPFM_TPU_READ_FAULT_EVERY`` injecting
+     transient read faults (healed by ResilientStream inside the stream
+     source). SIGTERM it at the hold sentinel mid-stream; it must drain
+     any in-flight publish, force-save, and exit 42.
+  2. **Feed + supervised resume.** New shards land in the directory
+     (atomic rename, exactly how a producer should write). The supervised
+     relaunches re-preempt themselves every few steps (>= 2 full
+     SIGTERM/resume cycles in total) until the stream idle-timeout ends
+     the run cleanly.
+  3. **Artifact audit.** Every published artifact dir must load via
+     ``load_serving`` (completion marker + params + serving fn all
+     intact), versions must be strictly monotonic in publish order, and
+     ``LATEST`` must resolve to the newest version.
+  4. **Replay parity.** A clean, uninterrupted online run over the same
+     final shard set (fresh model_dir) must publish bit-identical params
+     at every version the two runs share — and both runs must share the
+     final version and the same final step count: each record trained
+     exactly once across every preemption.
+
+Run on CPU:  JAX_PLATFORMS=cpu python scripts/online_drill.py
+"""
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import orbax.checkpoint as ocp
+
+from deepfm_tpu.config import Config
+from deepfm_tpu.data import libsvm
+from deepfm_tpu.train import tasks
+from deepfm_tpu.utils import export as export_lib
+from deepfm_tpu.utils import faults as faults_lib
+from deepfm_tpu.utils import preempt as preempt_lib
+
+from fault_drill import assert_tree_equal, final_params
+from supervise import run_supervised
+
+FEATURE_SIZE = 64
+FIELD_SIZE = 5
+NUM_FILES = 4            # first half pre-staged, second half fed live
+RECORDS_PER_FILE = 48    # batch 16 -> 3 batches/file, 12 steps total
+INITIAL_FILES = 2
+HOLD_AFTER_STEPS = 3     # SIGTERM point: mid-stream of the initial shards
+RESUME_PREEMPT_EVERY = 4  # supervised relaunches re-preempt this often
+PUBLISH_EVERY_STEPS = 4   # boundary crossings at steps 4, 8, 12
+READ_FAULT_EVERY = 7      # every 7th read fails once (healed in-stream)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _flags(data_dir, model_dir, **kw):
+    base = dict(
+        task_type="train", data_dir=data_dir, model_dir=model_dir,
+        feature_size=FEATURE_SIZE, field_size=FIELD_SIZE, embedding_size=4,
+        deep_layers="8", dropout="1.0", batch_size=16, num_epochs=1,
+        compute_dtype="float32", mesh_data=1, log_steps=0,
+        scale_lr_by_world=False, seed=17, verify_crc=True,
+        save_checkpoints_steps=0, io_retry_backoff_secs=0.0,
+        pipe_mode=1, online_mode=1, steps_per_loop=1,
+        publish_every_steps=PUBLISH_EVERY_STEPS,
+        stream_poll_secs=0.1, stream_idle_timeout_secs=2.0)
+    base.update(kw)
+    return base
+
+
+def _cmd(flags):
+    argv = [sys.executable, "-m", "deepfm_tpu.launch"]
+    for name, value in flags.items():
+        argv += [f"--{name}", str(int(value) if isinstance(value, bool)
+                                  else value)]
+    return argv
+
+
+def _env(**extra):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=_REPO_ROOT + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    for k in ("DEEPFM_TPU_PREEMPT_HOLD_AFTER_STEPS",
+              "DEEPFM_TPU_PREEMPT_AFTER_STEPS", faults_lib.READ_FAULT_ENV):
+        env.pop(k, None)
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def _feed(src_path, data_dir):
+    """Deliver one shard the way a producer must: full write to a hidden
+    temp name, then atomic rename into the watched directory."""
+    tmp = os.path.join(data_dir, "." + os.path.basename(src_path) + ".part")
+    shutil.copyfile(src_path, tmp)
+    os.replace(tmp, os.path.join(data_dir, os.path.basename(src_path)))
+
+
+def _artifact_params(artifact_dir):
+    restored = ocp.StandardCheckpointer().restore(
+        os.path.join(os.path.abspath(artifact_dir), "params.ckpt"))
+    return restored["params"]
+
+
+def _audit_publish_dir(publish_dir, say):
+    """Assert every artifact loads, versions are publish-order monotonic,
+    LATEST resolves to the newest. Returns {version_step: artifact_dir}."""
+    versions = {}
+    for name in os.listdir(publish_dir):
+        path = os.path.join(publish_dir, name)
+        if not os.path.isdir(path):
+            continue
+        assert not name.startswith("."), (
+            f"staging dir {name} leaked into {publish_dir}")
+        versions[int(name)] = path
+    assert versions, f"no artifacts published under {publish_dir}"
+    for step, path in sorted(versions.items()):
+        serve = export_lib.load_serving(path)  # raises on any torn artifact
+        probs = serve(np.zeros((2, FIELD_SIZE), np.int64),
+                      np.ones((2, FIELD_SIZE), np.float32))
+        assert probs.shape[0] == 2 and np.all(np.isfinite(probs)), (
+            f"artifact {path} served non-finite output")
+        with open(os.path.join(path, export_lib.COMPLETE_MARKER)) as f:
+            assert json.load(f)["step"] == step, (
+                f"artifact {path} marker step != dir version")
+    by_mtime = sorted(versions.items(),
+                      key=lambda kv: os.path.getmtime(kv[1]))
+    published_order = [step for step, _ in by_mtime]
+    assert published_order == sorted(published_order), (
+        f"versions not monotonic in publish order: {published_order}")
+    latest = export_lib.read_latest(publish_dir)
+    assert latest is not None and int(os.path.basename(latest)) == max(
+        versions), f"LATEST resolves to {latest}, newest is {max(versions)}"
+    say(f"audited {len(versions)} artifact(s): all load, "
+        f"monotonic, LATEST={max(versions)}")
+    return versions
+
+
+def run_drill(workdir, verbose=True):
+    def say(msg):
+        if verbose:
+            print(f"[online_drill] {msg}")
+
+    # All shards generated up front into a source dir; the live dir starts
+    # with the first half and receives the rest mid-run.
+    src_dir = os.path.join(workdir, "src")
+    shards = sorted(libsvm.generate_synthetic_ctr(
+        src_dir, num_files=NUM_FILES, examples_per_file=RECORDS_PER_FILE,
+        feature_size=FEATURE_SIZE, field_size=FIELD_SIZE, prefix="tr",
+        seed=5))
+    data_dir = os.path.join(workdir, "data")
+    os.makedirs(data_dir)
+    for path in shards[:INITIAL_FILES]:
+        _feed(path, data_dir)
+
+    # 1. Live online job under injected read faults; SIGTERM at the hold
+    # sentinel mid-stream -> drains publish, force-saves, exits 42.
+    model_dir = os.path.join(workdir, "ckpt_online")
+    flags = _flags(data_dir, model_dir)
+    sentinel = os.path.join(model_dir, ".preempt_hold")
+    proc = subprocess.Popen(
+        _cmd(flags), cwd=_REPO_ROOT,
+        env=_env(DEEPFM_TPU_PREEMPT_HOLD_AFTER_STEPS=HOLD_AFTER_STEPS,
+                 **{faults_lib.READ_FAULT_ENV: READ_FAULT_EVERY}))
+    deadline = time.time() + 300.0
+    while not os.path.exists(sentinel):
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"online run exited (code {proc.returncode}) before the "
+                f"hold point")
+        if time.time() > deadline:
+            proc.kill()
+            raise AssertionError("timed out waiting for the hold sentinel")
+        time.sleep(0.05)
+    proc.send_signal(signal.SIGTERM)
+    rc = proc.wait(timeout=300)
+    assert rc == preempt_lib.EXIT_PREEMPTED, (
+        f"preempted online run exited {rc}, "
+        f"expected {preempt_lib.EXIT_PREEMPTED}")
+    say(f"SIGTERM at step >= {HOLD_AFTER_STEPS} under read faults: "
+        f"exit {rc}, checkpoint + stream sidecar saved")
+
+    # 2. The stream grows; supervised resume re-preempts itself every few
+    # steps until the idle timeout ends the run cleanly (>= 2 total
+    # SIGTERM/resume cycles counting the hold kill above).
+    for path in shards[INITIAL_FILES:]:
+        _feed(path, data_dir)
+    say(f"fed {NUM_FILES - INITIAL_FILES} new shard(s) into the live dir")
+    restarts = []
+    rc = run_supervised(
+        _cmd(flags), max_restarts=10, backoff_secs=0.0,
+        spawn=lambda c: subprocess.call(
+            c, cwd=_REPO_ROOT,
+            env=_env(DEEPFM_TPU_PREEMPT_AFTER_STEPS=RESUME_PREEMPT_EVERY)),
+        log=lambda m: (restarts.append(m), say(m)))
+    assert rc == 0, f"supervised online resume failed with exit code {rc}"
+    assert any("restart 1/" in m for m in restarts), (
+        "supervisor never restarted; the re-preempt trigger did not fire")
+
+    # The stream sidecar must have admitted every shard, in sorted order
+    # (the producer feeds names in sorted order, so admission == sorted).
+    with open(os.path.join(model_dir, "stream_manifest.json")) as f:
+        admitted = [os.path.basename(p)
+                    for p, _ in json.load(f)["admitted"]]
+    expect = [os.path.basename(p) for p in shards]
+    assert admitted == expect, (
+        f"sidecar admitted {admitted}, expected {expect}")
+
+    # 3. Artifact audit of the interrupted-and-resumed run.
+    publish_dir = os.path.join(model_dir, "publish")
+    versions_live = _audit_publish_dir(publish_dir, say)
+
+    # 4. Clean uninterrupted replay over the same final shard set.
+    clean_model_dir = os.path.join(workdir, "ckpt_clean")
+    tasks.run(Config(**_flags(data_dir, clean_model_dir)))
+    clean_publish = os.path.join(clean_model_dir, "publish")
+    versions_clean = _audit_publish_dir(clean_publish, say)
+
+    _, step_live = final_params(Config(**_flags(data_dir, model_dir)))
+    _, step_clean = final_params(
+        Config(**_flags(data_dir, clean_model_dir)))
+    assert step_live == step_clean, (
+        f"final step diverged: interrupted {step_live} vs clean "
+        f"{step_clean} — some record trained twice or never")
+
+    final_version = max(versions_clean)
+    assert final_version in versions_live, (
+        f"final version {final_version} missing from the interrupted run "
+        f"({sorted(versions_live)})")
+    common = sorted(set(versions_live) & set(versions_clean))
+    for step in common:
+        assert_tree_equal(
+            _artifact_params(versions_live[step]),
+            _artifact_params(versions_clean[step]),
+            f"published params @ step {step} (interrupted vs clean)")
+    say(f"replay parity: {len(common)} common version(s) {common} "
+        f"bit-identical; final step {step_live} matches")
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--workdir", default="",
+                    help="scratch dir (default: a fresh TemporaryDirectory)")
+    args = ap.parse_args()
+    if args.workdir:
+        os.makedirs(args.workdir, exist_ok=True)
+        run_drill(args.workdir)
+    else:
+        with tempfile.TemporaryDirectory(prefix="online_drill_") as d:
+            run_drill(d)
+    print("[online_drill] PASS")
+
+
+if __name__ == "__main__":
+    main()

@@ -29,18 +29,25 @@ from deepfm_tpu.utils import preempt as preempt_lib
 
 
 def run_supervised(cmd, *, max_restarts=5, backoff_secs=1.0,
-                   sleep=time.sleep, spawn=None, log=print):
+                   healthy_secs=0.0, sleep=time.sleep, spawn=None,
+                   log=print, clock=time.monotonic):
     """Run ``cmd`` until it exits cleanly, restarting on preemption codes.
 
     Returns the final exit code: 0 on success, the child's code on a
     non-restartable failure, or the last restartable code when the restart
-    budget is exhausted. ``sleep``/``spawn`` are injectable for tests
-    (``spawn(cmd) -> int`` defaults to ``subprocess.call``).
+    budget is exhausted. With ``healthy_secs > 0``, a child that ran at
+    least that long before a restartable exit resets the restart counter
+    and backoff — an online job preempted once a day must not exhaust a
+    lifetime budget sized for crash loops. ``sleep``/``spawn``/``clock``
+    are injectable for tests (``spawn(cmd) -> int`` defaults to
+    ``subprocess.call``).
     """
     spawn = spawn if spawn is not None else (lambda c: subprocess.call(c))
     restarts = 0
     while True:
+        started = clock()
         rc = spawn(cmd)
+        ran_secs = clock() - started
         if rc == 0:
             if restarts:
                 log(f"[supervise] run completed after {restarts} restart(s)")
@@ -49,6 +56,10 @@ def run_supervised(cmd, *, max_restarts=5, backoff_secs=1.0,
             log(f"[supervise] child failed with non-restartable exit code "
                 f"{rc}; giving up")
             return rc
+        if healthy_secs > 0 and ran_secs >= healthy_secs and restarts:
+            log(f"[supervise] child ran healthy for {ran_secs:.0f}s "
+                f"(>= {healthy_secs:g}s); resetting restart counter")
+            restarts = 0
         if restarts >= max_restarts:
             log(f"[supervise] restart budget exhausted "
                 f"({restarts}/{max_restarts}); last exit code {rc}")
@@ -68,6 +79,10 @@ def main():
                     help="restart budget for preemption exits (default 5)")
     ap.add_argument("--backoff_secs", type=float, default=1.0,
                     help="base backoff, doubled per restart (default 1.0)")
+    ap.add_argument("--healthy_secs", type=float, default=0.0,
+                    help="a child that ran at least this long before a "
+                         "restartable exit resets the restart counter "
+                         "(0 = lifetime budget; default 0)")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="command to supervise (prefix with --)")
     args = ap.parse_args()
@@ -75,7 +90,8 @@ def main():
     if not cmd:
         ap.error("no command given (put it after --)")
     return run_supervised(cmd, max_restarts=args.max_restarts,
-                          backoff_secs=args.backoff_secs)
+                          backoff_secs=args.backoff_secs,
+                          healthy_secs=args.healthy_secs)
 
 
 if __name__ == "__main__":

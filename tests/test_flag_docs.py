@@ -1,0 +1,23 @@
+"""Every ``Config`` field must be documented in docs/MIGRATION.md — a new
+flag without its migration row fails tier-1, not code review."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+import check_flag_docs  # noqa: E402
+
+
+def test_all_config_flags_documented():
+    missing = check_flag_docs.missing_flags()
+    assert missing == [], (
+        f"Config fields missing from docs/MIGRATION.md: {missing} — "
+        "add a row/paragraph for each (see scripts/check_flag_docs.py)")
+
+
+def test_checker_detects_missing_flag():
+    # The checker itself must not silently pass on an empty doc.
+    missing = check_flag_docs.missing_flags(doc_text="nothing documented")
+    assert "batch_size" in missing and "online_mode" in missing

@@ -85,3 +85,44 @@ def test_auc_update_jittable():
     st = f(metrics.auc_init(200), jnp.asarray(probs), jnp.asarray(labels))
     want = metrics.auc_numpy_reference(probs, labels)
     assert abs(float(metrics.auc_compute(st)) - want) < 0.01
+
+
+class TestWindowedAuc:
+    """Sliding-window streaming AUC for online eval: slices tagged with the
+    training step, evicted once older than the window."""
+
+    def test_single_slice_matches_cumulative(self):
+        probs, labels = _data(seed=10)
+        w = metrics.WindowedAuc(window_steps=100, num_bins=200)
+        w.update(1, probs, labels)
+        st = metrics.auc_update(
+            metrics.auc_init(200), jnp.asarray(probs), jnp.asarray(labels))
+        assert abs(w.compute() - float(metrics.auc_compute(st))) < 1e-6
+        assert w.examples == len(probs)
+
+    def test_eviction_drops_stale_slices(self):
+        # Slice at step 1 is garbage (inverted scores); the window must
+        # forget it once the stream moves window_steps past it.
+        probs, labels = _data(seed=11)
+        w = metrics.WindowedAuc(window_steps=10, num_bins=200)
+        w.update(1, 1.0 - probs, labels)   # anti-predictive slice
+        auc_poisoned = w.compute()
+        assert auc_poisoned < 0.5
+        w.update(12, probs, labels)        # step 1 <= 12 - 10: evicted
+        want = metrics.auc_numpy_reference(probs, labels)
+        assert abs(w.compute() - want) < 0.01
+        assert w.examples == len(probs)    # only the live slice remains
+
+    def test_window_keeps_recent_slices(self):
+        probs, labels = _data(seed=12)
+        half = len(probs) // 2
+        w = metrics.WindowedAuc(window_steps=100, num_bins=200)
+        w.update(1, probs[:half], labels[:half])
+        w.update(50, probs[half:], labels[half:])  # still inside the window
+        want = metrics.auc_numpy_reference(probs, labels)
+        assert abs(w.compute() - want) < 0.01
+        assert w.examples == len(probs)
+
+    def test_empty_window_is_zero(self):
+        w = metrics.WindowedAuc(window_steps=10)
+        assert w.compute() == 0.0 and w.examples == 0

@@ -1,0 +1,147 @@
+"""In-process online-mode integration: continuous training from a growing
+directory with atomic hot publishing, replay-exact preempt/resume (each
+record trained exactly once), sliding-window eval, and config validation.
+The subprocess/SIGTERM/fault version of this lives in
+``scripts/online_drill.py`` (wrapped as a slow test below)."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+from deepfm_tpu.config import Config
+from deepfm_tpu.data import libsvm
+from deepfm_tpu.train import tasks
+from deepfm_tpu.utils import export as export_lib
+from deepfm_tpu.utils import preempt as preempt_lib
+
+FEATURE_SIZE = 64
+FIELD_SIZE = 5
+RECORDS_PER_FILE = 48  # batch 16 -> 3 steps per shard
+
+
+@pytest.fixture(autouse=True)
+def _skip_tf_savedmodel(monkeypatch):
+    monkeypatch.setattr(export_lib, "_export_tf_savedmodel",
+                        lambda *a, **k: None)
+    # The process-wide preemption flag survives a Preempted raise; stale
+    # state from another test must not end this one's run early.
+    preempt_lib.get_listener().clear()
+    yield
+    preempt_lib.get_listener().clear()
+
+
+def _make_shards(data_dir, num_files, seed=5, prefix="tr"):
+    return sorted(libsvm.generate_synthetic_ctr(
+        str(data_dir), num_files=num_files,
+        examples_per_file=RECORDS_PER_FILE, feature_size=FEATURE_SIZE,
+        field_size=FIELD_SIZE, prefix=prefix, seed=seed))
+
+
+def _cfg(data_dir, model_dir, **kw):
+    base = dict(
+        task_type="train", data_dir=str(data_dir), model_dir=str(model_dir),
+        feature_size=FEATURE_SIZE, field_size=FIELD_SIZE, embedding_size=4,
+        deep_layers="8", dropout="1.0", batch_size=16, num_epochs=1,
+        compute_dtype="float32", mesh_data=1, log_steps=0,
+        scale_lr_by_world=False, seed=17, verify_crc=True,
+        save_checkpoints_steps=0, io_retry_backoff_secs=0.0,
+        pipe_mode=1, online_mode=1, steps_per_loop=1,
+        publish_every_steps=2, stream_poll_secs=0.05,
+        stream_idle_timeout_secs=1.0)
+    base.update(kw)
+    return Config(**base)
+
+
+class TestOnlineRun:
+    def test_end_to_end_publish_and_sidecar(self, tmp_path):
+        data = tmp_path / "data"
+        _make_shards(data, 2)
+        res = tasks.run(_cfg(data, tmp_path / "ckpt"))
+        assert res["steps"] == 6  # 2 shards x 3 batches, exactly once
+        assert res["publish_failures"] == 0
+
+        # The terminal step is always published (forced final publish), and
+        # versions are strictly increasing.
+        versions = res["published_versions"]
+        assert versions and versions[-1] == 6
+        assert versions == sorted(set(versions))
+
+        publish_dir = str(tmp_path / "ckpt" / "publish")
+        for name in os.listdir(publish_dir):
+            assert not name.startswith("."), f"staging leak: {name}"
+        for v in versions:
+            serve = export_lib.load_serving(os.path.join(publish_dir, str(v)))
+            probs = serve(np.zeros((2, FIELD_SIZE), np.int32),
+                          np.ones((2, FIELD_SIZE), np.float32))
+            assert np.all(np.isfinite(probs))
+        latest = export_lib.read_latest(publish_dir)
+        assert int(os.path.basename(latest)) == max(versions)
+
+        # High-water-mark sidecar recorded both shards at full size.
+        with open(tmp_path / "ckpt" / "stream_manifest.json") as f:
+            meta = json.load(f)
+        assert len(meta["admitted"]) == 2
+        assert all(size > 0 for _, size in meta["admitted"])
+
+    def test_preempt_resume_trains_each_record_once(self, tmp_path,
+                                                    monkeypatch):
+        from fault_drill import assert_tree_equal, final_params
+        data = tmp_path / "data"
+        shards = _make_shards(data, 4)
+        # Hide the back half: it "arrives" after the preemption.
+        hidden = [p + ".hold" for p in shards[2:]]
+        for src, dst in zip(shards[2:], hidden):
+            os.rename(src, dst)
+
+        live = _cfg(data, tmp_path / "ckpt")
+        monkeypatch.setenv("DEEPFM_TPU_PREEMPT_AFTER_STEPS", "3")
+        with pytest.raises(preempt_lib.Preempted):
+            tasks.run(live)
+        monkeypatch.delenv("DEEPFM_TPU_PREEMPT_AFTER_STEPS")
+        preempt_lib.get_listener().clear()
+
+        for src, dst in zip(hidden, shards[2:]):
+            os.rename(src, dst)
+        res = tasks.run(live)
+        assert res["steps"] == 12  # 4 shards x 3 batches across both runs
+
+        # A clean, uninterrupted run over the same final shard set lands on
+        # bit-identical params: no record trained twice or dropped.
+        clean = _cfg(data, tmp_path / "ckpt_clean")
+        tasks.run(clean)
+        p_live, s_live = final_params(live)
+        p_clean, s_clean = final_params(clean)
+        assert s_live == s_clean == 12
+        assert_tree_equal(p_live, p_clean,
+                          "final params (preempted+resumed vs clean)")
+
+    def test_windowed_eval_reported(self, tmp_path):
+        data = tmp_path / "data"
+        _make_shards(data, 2)
+        _make_shards(data, 1, seed=8, prefix="va")
+        res = tasks.run(_cfg(data, tmp_path / "ckpt",
+                             online_eval_window_steps=8))
+        assert 0.0 < res["auc"] <= 1.0
+        assert res["window_examples"] == RECORDS_PER_FILE
+
+
+class TestConfigValidation:
+    def test_online_mode_requires_pipe_mode(self, tmp_path):
+        with pytest.raises(ValueError, match="online_mode"):
+            _cfg(tmp_path, tmp_path / "c", pipe_mode=0)
+
+    def test_online_mode_requires_single_epoch(self, tmp_path):
+        with pytest.raises(ValueError, match="online_mode"):
+            _cfg(tmp_path, tmp_path / "c", num_epochs=3)
+
+
+@pytest.mark.slow
+def test_online_drill_end_to_end(tmp_path):
+    import online_drill
+    online_drill.run_drill(str(tmp_path), verbose=False)

@@ -101,6 +101,53 @@ class TestSupervisor:
         assert rc == 42
         assert sleeps == [0.5, 1.0]  # two restarts, then give up
 
+    def _run_timed(self, runs, **kw):
+        """Each run is (duration_secs, exit_code); injectable clock ticks
+        by the child's duration at each spawn."""
+        seq = list(runs)
+        t = [0.0]
+        sleeps = []
+
+        def spawn(cmd):
+            secs, rc = seq.pop(0)
+            t[0] += secs
+            return rc
+
+        rc = supervise.run_supervised(
+            ["train"], spawn=spawn, sleep=sleeps.append,
+            log=lambda m: None, clock=lambda: t[0], **kw)
+        return rc, sleeps, seq
+
+    def test_healthy_run_resets_restart_budget(self):
+        # An online job preempted once a day must not exhaust a lifetime
+        # budget sized for crash loops: 5 preemptions, each after a run
+        # longer than healthy_secs, survive a max_restarts=2 budget.
+        rc, sleeps, left = self._run_timed(
+            [(100.0, 42)] * 5 + [(100.0, 0)],
+            max_restarts=2, backoff_secs=1.0, healthy_secs=50.0)
+        assert rc == 0 and left == []
+        # The counter resets each time, so backoff never escalates.
+        assert sleeps == [1.0] * 5
+
+    def test_short_runs_still_exhaust_budget(self):
+        rc, _, left = self._run_timed(
+            [(1.0, 42)] * 10, max_restarts=2, backoff_secs=0.0,
+            healthy_secs=50.0)
+        assert rc == 42 and len(left) == 7  # 1 first run + 2 restarts
+
+    def test_healthy_reset_disabled_by_default(self):
+        rc, _, _ = self._run_timed(
+            [(100.0, 42)] * 10, max_restarts=2, backoff_secs=0.0)
+        assert rc == 42  # long runs don't help without --healthy_secs
+
+    def test_crash_loop_after_healthy_run_still_bounded(self):
+        # One healthy run resets the counter once; the subsequent crash
+        # loop of short runs still hits the budget.
+        rc, _, left = self._run_timed(
+            [(1.0, 42), (1.0, 42), (100.0, 42)] + [(1.0, 42)] * 10,
+            max_restarts=2, backoff_secs=0.0, healthy_secs=50.0)
+        assert rc == 42 and len(left) == 8
+
 
 def _state(step=0):
     return {"w": np.arange(8, dtype=np.float32) + step,

@@ -1,0 +1,286 @@
+"""Atomic hot-publish tests: crash-at-any-stage atomicity (the `LATEST`
+pointer always resolves to a complete previous artifact), deterministic
+publish cadence across resume, never-backwards pointer, skip-on-busy
+accounting, wedged-publish watchdog, completion-marker enforcement in
+``load_serving``, and the hot-swap watcher."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from deepfm_tpu.config import Config
+from deepfm_tpu.train import Trainer
+from deepfm_tpu.train.publish import Publisher
+from deepfm_tpu.utils import export as export_lib
+from deepfm_tpu.utils import faults as faults_lib
+
+FIELD_SIZE = 5
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = Config(
+        feature_size=64, field_size=FIELD_SIZE, embedding_size=4,
+        deep_layers="8", dropout="1.0", batch_size=16,
+        compute_dtype="float32", mesh_data=1, log_steps=0, seed=11)
+    trainer = Trainer(cfg)
+    return cfg, trainer, trainer.init_state()
+
+
+@pytest.fixture(autouse=True)
+def _skip_tf_savedmodel(monkeypatch):
+    # The TF SavedModel write dominates export time (~10s+); the atomicity
+    # machinery under test here is independent of which files are staged.
+    monkeypatch.setattr(export_lib, "_export_tf_savedmodel",
+                        lambda *a, **k: None)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _publisher(tiny, publish_dir, **kw):
+    cfg, trainer, _ = tiny
+    kw.setdefault("every_steps", 4)
+    return Publisher(trainer.model, cfg, str(publish_dir), **kw)
+
+
+def _stub_jobs(pub):
+    """Replace the artifact write with a pure marker of which steps ran —
+    cadence/bookkeeping tests don't need real exports."""
+    done = []
+    pub._do_publish = lambda params, mstate, step: done.append(step) or "ok"
+    return done
+
+
+class TestAtomicity:
+    def test_publish_roundtrip(self, tiny, tmp_path):
+        _, _, state = tiny
+        pub = _publisher(tiny, tmp_path)
+        pub.publish_now(state, 2)
+        pub.close()
+        artifact = export_lib.read_latest(str(tmp_path))
+        assert artifact is not None and os.path.basename(artifact) == "2"
+        serve = export_lib.load_serving(artifact)
+        probs = serve(np.zeros((3, FIELD_SIZE), np.int32),
+                      np.ones((3, FIELD_SIZE), np.float32))
+        assert probs.shape == (3,) and np.all(np.isfinite(probs))
+        assert pub.stats()["published_versions"] == [2]
+
+    def test_crash_before_rename_keeps_previous_artifact(self, tiny, tmp_path):
+        _, _, state = tiny
+        pub = _publisher(tiny, tmp_path)
+        pub.publish_now(state, 1)
+        assert pub.drain()
+        faults_lib.set_publish_crash("before_rename")
+        pub.publish_now(state, 2)
+        assert pub.drain()
+        # The torn publish is invisible: no final dir, pointer unmoved.
+        assert not os.path.isdir(tmp_path / "2")
+        latest = export_lib.read_latest(str(tmp_path))
+        assert latest is not None and os.path.basename(latest) == "1"
+        assert export_lib.load_serving(latest) is not None
+        assert pub.publish_failures == 1
+        # Retry at the next cadence succeeds and moves the pointer.
+        pub.publish_now(state, 3)
+        pub.close()
+        assert os.path.basename(export_lib.read_latest(str(tmp_path))) == "3"
+        assert pub.stats()["published_versions"] == [1, 3]
+
+    def test_crash_between_rename_and_latest_heals_on_retry(
+            self, tiny, tmp_path):
+        _, _, state = tiny
+        pub = _publisher(tiny, tmp_path)
+        pub.publish_now(state, 1)
+        assert pub.drain()
+        faults_lib.set_publish_crash("after_rename_before_latest")
+        pub.publish_now(state, 4)
+        assert pub.drain()
+        # The artifact is fully visible and complete, only the pointer is
+        # stale — a reader following LATEST still gets artifact 1.
+        assert export_lib.load_serving(str(tmp_path / "4")) is not None
+        assert os.path.basename(export_lib.read_latest(str(tmp_path))) == "1"
+        assert pub.publish_failures == 1
+        # The idempotent republish of the same step skips the export but
+        # still advances the pointer.
+        pub.publish_now(state, 4)
+        pub.close()
+        assert os.path.basename(export_lib.read_latest(str(tmp_path))) == "4"
+
+    def test_latest_never_regresses(self, tiny, tmp_path):
+        _, _, state = tiny
+        pub = _publisher(tiny, tmp_path)
+        pub.publish_now(state, 10)
+        assert pub.drain()
+        # A resumed run republishing an older cadence step must not point
+        # serving back in time.
+        pub.publish_now(state, 5)
+        pub.close()
+        assert export_lib.load_serving(str(tmp_path / "5")) is not None
+        assert os.path.basename(export_lib.read_latest(str(tmp_path))) == "10"
+
+
+class TestCadence:
+    def test_boundary_crossing_steps(self, tiny, tmp_path):
+        _, _, state = tiny
+        pub = _publisher(tiny, tmp_path, every_steps=4)
+        done = _stub_jobs(pub)
+        for step in range(1, 13):
+            pub.maybe_publish(state, step)
+            pub.drain()
+        pub.close()
+        assert done == [4, 8, 12]
+        assert pub.stats()["published_versions"] == [4, 8, 12]
+
+    def test_seed_cadence_matches_fresh_run(self, tiny, tmp_path):
+        # A run restored at step 5 must publish at 8 — the boundary a fresh
+        # run would cross — not "restore step + 1".
+        _, _, state = tiny
+        pub = _publisher(tiny, tmp_path, every_steps=4)
+        done = _stub_jobs(pub)
+        pub.seed_cadence(5)
+        for step in range(6, 10):
+            pub.maybe_publish(state, step)
+            pub.drain()
+        pub.close()
+        assert done == [8]
+
+    def test_time_cadence(self, tiny, tmp_path):
+        _, _, state = tiny
+        clock = FakeClock()
+        pub = _publisher(tiny, tmp_path, every_steps=0, every_secs=10.0,
+                         clock=clock)
+        done = _stub_jobs(pub)
+        pub.maybe_publish(state, 1)
+        clock.t = 11.0
+        pub.maybe_publish(state, 2)
+        pub.drain()
+        clock.t = 15.0
+        pub.maybe_publish(state, 3)  # only 4s since last publish
+        pub.close()
+        assert done == [2]
+
+    def test_busy_cadence_skipped_not_queued(self, tiny, tmp_path):
+        _, _, state = tiny
+        pub = _publisher(tiny, tmp_path, every_steps=4)
+        gate = threading.Event()
+        started = threading.Event()
+        pub._do_publish = (
+            lambda p, m, s: (started.set(), gate.wait(30), "ok")[-1])
+        assert pub.maybe_publish(state, 4)
+        assert started.wait(30)
+        assert not pub.maybe_publish(state, 8)  # in flight: dropped
+        assert pub.skipped_inflight == 1
+        gate.set()
+        pub.drain()
+        assert pub.maybe_publish(state, 12)
+        pub.close()
+        assert pub.stats()["published_versions"] == [4, 12]
+        assert pub.stats()["publish_skipped_inflight"] == 1
+
+
+class TestWatchdog:
+    def test_wedged_publish_trips_abort(self, tiny, tmp_path):
+        _, _, state = tiny
+        clock = FakeClock()
+        aborts = []
+        pub = _publisher(tiny, tmp_path, timeout_s=5.0, clock=clock,
+                         abort=aborts.append)
+        gate = threading.Event()
+        pub._do_publish = lambda p, m, s: gate.wait(30)
+        pub.publish_now(state, 4)
+        clock.t = 4.0
+        pub.check_wedged()
+        assert not aborts
+        clock.t = 6.0
+        pub.check_wedged()
+        assert len(aborts) == 1 and "publish of step 4" in aborts[0]
+        gate.set()
+        pub.close()
+
+
+class TestMarkerEnforcement:
+    def test_truncated_artifact_refused(self, tiny, tmp_path):
+        # Regression: an artifact dir missing its completion marker (crashed
+        # export) must fail with the typed error, not a restore traceback.
+        cfg, trainer, state = tiny
+        artifact = str(tmp_path / "1")
+        export_lib.export_serving(trainer.model, state, cfg, artifact)
+        export_lib.load_serving(artifact)  # complete: loads fine
+        os.remove(os.path.join(artifact, export_lib.COMPLETE_MARKER))
+        with pytest.raises(export_lib.ArtifactIncomplete):
+            export_lib.load_serving(artifact)
+
+    def test_empty_dir_refused(self, tmp_path):
+        with pytest.raises(export_lib.ArtifactIncomplete):
+            export_lib.load_serving(str(tmp_path))
+
+    def test_read_latest_dangling_pointer(self, tmp_path):
+        assert export_lib.read_latest(str(tmp_path)) is None
+        export_lib.write_latest(str(tmp_path), "7")
+        assert export_lib.read_latest(str(tmp_path)) is None  # dir absent
+        os.makedirs(tmp_path / "7")
+        assert export_lib.read_latest(str(tmp_path)) == str(tmp_path / "7")
+
+
+class TestLatestWatcher:
+    def _fake_artifact(self, publish_dir, version):
+        os.makedirs(os.path.join(publish_dir, version))
+        export_lib.write_latest(publish_dir, version)
+
+    def test_hot_swap_follows_latest(self, tmp_path):
+        pub_dir = str(tmp_path)
+        loads = []
+
+        def loader(path):
+            loads.append(path)
+            return lambda ids, vals: os.path.basename(path)
+
+        self._fake_artifact(pub_dir, "1")
+        w = export_lib.watch_latest(pub_dir, loader=loader, start=False)
+        assert w.swap_count == 1 and w(None, None) == "1"
+        assert not w.check_once()  # pointer unmoved: no reload
+        self._fake_artifact(pub_dir, "2")
+        assert w.check_once()
+        assert w.swap_count == 2 and w(None, None) == "2"
+        assert loads == [os.path.join(pub_dir, "1"),
+                         os.path.join(pub_dir, "2")]
+        w.close()
+
+    def test_failed_load_keeps_current_model(self, tmp_path):
+        pub_dir = str(tmp_path)
+
+        def loader(path):
+            if path.endswith("13"):
+                raise export_lib.ArtifactIncomplete(path)
+            return lambda ids, vals: os.path.basename(path)
+
+        self._fake_artifact(pub_dir, "1")
+        w = export_lib.watch_latest(pub_dir, loader=loader, start=False)
+        self._fake_artifact(pub_dir, "13")  # racing an in-flight publish
+        assert not w.check_once()
+        assert w(None, None) == "1" and w.swap_count == 1
+        w.close()
+
+    def test_no_artifact_yet_raises(self, tmp_path):
+        w = export_lib.watch_latest(str(tmp_path), start=False)
+        with pytest.raises(RuntimeError, match="no artifact published"):
+            w(None, None)
+        w.close()
+
+
+class TestMarkerStep:
+    def test_marker_records_step(self, tiny, tmp_path):
+        cfg, trainer, state = tiny
+        pub = _publisher(tiny, tmp_path)
+        pub.publish_now(state, 6)
+        pub.close()
+        with open(tmp_path / "6" / export_lib.COMPLETE_MARKER) as f:
+            assert json.load(f)["step"] == 6

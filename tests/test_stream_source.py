@@ -1,0 +1,232 @@
+"""Unbounded stream source tests: admission protocol (2-poll size settling,
+manifest mode), late/duplicate/torn healing with DataHealth accounting,
+high-water-mark sidecar replay, idle-timeout EOF, and the bounded-read
+contract. Injectable clock + sleep — no real polling waits."""
+
+import json
+import os
+
+import pytest
+
+from deepfm_tpu.data import fileio
+from deepfm_tpu.data.health import DataHealth
+from deepfm_tpu.data.stream import UnboundedFileStream
+
+
+class FakeClock:
+    """Deterministic monotonic clock; sleeping advances it."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, secs):
+        self.t += max(float(secs), 0.01)
+
+
+def _write(dirpath, name, data):
+    path = os.path.join(str(dirpath), name)
+    with open(path, "wb") as f:
+        f.write(data)
+    return path
+
+
+def _stream(source, tmp_path, **kw):
+    clock = kw.pop("clock", None) or FakeClock()
+    kw.setdefault("pattern", "*.bin")
+    kw.setdefault("poll_secs", 0.1)
+    kw.setdefault("health", DataHealth())
+    return UnboundedFileStream(source, clock=clock, sleep=clock.sleep, **kw), clock
+
+
+def _read_all(stream, chunk=1 << 16):
+    out = bytearray()
+    while True:
+        b = stream.read(chunk)
+        if not b:
+            return bytes(out)
+        out += b
+
+
+class TestAdmission:
+    def test_two_poll_settle_then_serve(self, tmp_path):
+        _write(tmp_path, "a.bin", b"alpha")
+        s, _ = _stream(str(tmp_path), tmp_path, idle_timeout_secs=1.0)
+        assert not s.poll_now()   # first sighting: settling
+        assert s.poll_now()       # size stable: admitted
+        assert s.files_admitted == [os.path.join(str(tmp_path), "a.bin")]
+        assert _read_all(s) == b"alpha"
+
+    def test_growth_after_admission_is_ignored(self, tmp_path):
+        path = _write(tmp_path, "a.bin", b"12345")
+        s, _ = _stream(str(tmp_path), tmp_path, idle_timeout_secs=1.0)
+        s.poll_now(), s.poll_now()
+        with open(path, "ab") as f:
+            f.write(b"LATE")  # write-once contract violated by the producer
+        # Replay-exactness: exactly the admitted 5 bytes are served.
+        assert _read_all(s) == b"12345"
+
+    def test_new_files_admitted_mid_stream(self, tmp_path):
+        _write(tmp_path, "a.bin", b"one.")
+        s, _ = _stream(str(tmp_path), tmp_path, idle_timeout_secs=1.0)
+        s.poll_now(), s.poll_now()
+        assert s.read(4) == b"one."
+        _write(tmp_path, "b.bin", b"two.")
+        s.poll_now(), s.poll_now()
+        assert s.read(4) == b"two."
+
+    def test_partial_read_returns_available_bytes(self, tmp_path):
+        # The framer treats any non-empty read as progress: a small fresh
+        # shard must reach the consumer without filling the whole request.
+        _write(tmp_path, "a.bin", b"tiny")
+        s, _ = _stream(str(tmp_path), tmp_path, idle_timeout_secs=1.0)
+        s.poll_now(), s.poll_now()
+        assert s.read(1 << 20) == b"tiny"
+
+    def test_unbounded_read_rejected(self, tmp_path):
+        s, _ = _stream(str(tmp_path), tmp_path)
+        with pytest.raises(ValueError):
+            s.read(-1)
+
+    def test_empty_file_never_admitted(self, tmp_path):
+        _write(tmp_path, "a.bin", b"")
+        s, _ = _stream(str(tmp_path), tmp_path)
+        assert not s.poll_now() and not s.poll_now()
+        assert s.files_admitted == []
+
+
+class TestAnomalies:
+    def test_late_file_admitted_and_counted(self, tmp_path):
+        _write(tmp_path, "b.bin", b"bb")
+        s, _ = _stream(str(tmp_path), tmp_path, idle_timeout_secs=1.0)
+        s.poll_now(), s.poll_now()
+        _write(tmp_path, "a.bin", b"aa")  # sorts before the admitted b.bin
+        s.poll_now(), s.poll_now()
+        assert s.health.late_files == 1
+        assert _read_all(s) == b"bb" + b"aa"  # admission order, not sorted
+
+    def test_duplicate_basename_skipped(self, tmp_path):
+        sub = tmp_path / "redelivered"
+        sub.mkdir()
+        _write(tmp_path, "a.bin", b"original")
+        _write(sub, "a.bin", b"duplicate")
+        manifest = _write(tmp_path, "manifest.txt", b"")
+        with open(manifest, "w") as f:
+            f.write(f"{tmp_path}/a.bin\n{sub}/a.bin\n")
+        s, _ = _stream(manifest, tmp_path, idle_timeout_secs=1.0)
+        s.poll_now()
+        assert s.health.duplicate_files == 1
+        assert _read_all(s) == b"original"
+
+    def test_vanished_file_counted_torn_and_skipped(self, tmp_path):
+        doomed = _write(tmp_path, "a.bin", b"gone")
+        _write(tmp_path, "b.bin", b"kept")
+        s, _ = _stream(str(tmp_path), tmp_path, idle_timeout_secs=1.0)
+        s.poll_now(), s.poll_now()
+        os.unlink(doomed)
+        assert _read_all(s) == b"kept"
+        assert s.health.torn_files == 1
+        assert s.health.bytes_discarded == 4
+
+    def test_shrunk_file_counted_torn(self, tmp_path):
+        path = _write(tmp_path, "a.bin", b"0123456789")
+        _write(tmp_path, "b.bin", b"next")
+        s, _ = _stream(str(tmp_path), tmp_path, idle_timeout_secs=1.0)
+        s.poll_now(), s.poll_now()
+        with open(path, "wb") as f:
+            f.write(b"0123")  # shrinks below admitted size mid-stream
+        out = _read_all(s)
+        assert out.endswith(b"next")
+        assert s.health.torn_files == 1
+
+
+class TestSidecar:
+    def test_replay_exact_restart(self, tmp_path):
+        side = str(tmp_path / "side.json")
+        _write(tmp_path, "a.bin", b"aaaa")
+        s, _ = _stream(str(tmp_path), tmp_path, sidecar_path=side,
+                       idle_timeout_secs=1.0)
+        s.poll_now(), s.poll_now()
+        assert s.read(2) == b"aa"
+        s.close()
+        # Restart: the sidecar replays the admitted set without a poll, at
+        # the recorded sizes — even though the file has since grown.
+        with open(os.path.join(str(tmp_path), "a.bin"), "ab") as f:
+            f.write(b"GROWTH")
+        s2, _ = _stream(str(tmp_path), tmp_path, sidecar_path=side,
+                        idle_timeout_secs=1.0)
+        assert s2.files_admitted == [os.path.join(str(tmp_path), "a.bin")]
+        assert _read_all(s2) == b"aaaa"
+
+    def test_sidecar_written_before_bytes_served(self, tmp_path):
+        side = str(tmp_path / "side.json")
+        _write(tmp_path, "a.bin", b"x" * 8)
+        s, _ = _stream(str(tmp_path), tmp_path, sidecar_path=side)
+        s.poll_now(), s.poll_now()
+        meta = json.loads(open(side).read())
+        assert [os.path.basename(p) for p, _ in meta["admitted"]] == ["a.bin"]
+        assert meta["admitted"][0][1] == 8
+
+    def test_corrupt_sidecar_warns_and_starts_fresh(self, tmp_path):
+        side = str(tmp_path / "side.json")
+        with open(side, "w") as f:
+            f.write('{"version": 1, "adm')  # torn write
+        _write(tmp_path, "a.bin", b"ok")
+        with pytest.warns(RuntimeWarning, match="unreadable"):
+            s, _ = _stream(str(tmp_path), tmp_path, sidecar_path=side,
+                           idle_timeout_secs=1.0)
+        s.poll_now(), s.poll_now()
+        assert _read_all(s) == b"ok"
+
+    def test_source_mismatch_ignores_sidecar(self, tmp_path):
+        side = str(tmp_path / "side.json")
+        fileio.write_atomic(side, json.dumps(
+            {"version": 1, "source": "/elsewhere", "pattern": "*",
+             "admitted": [["/elsewhere/z.bin", 3]]}))
+        with pytest.warns(RuntimeWarning, match="written for source"):
+            s, _ = _stream(str(tmp_path), tmp_path, sidecar_path=side)
+        assert s.files_admitted == []
+
+
+class TestEndOfStream:
+    def test_idle_timeout_eofs(self, tmp_path):
+        _write(tmp_path, "a.bin", b"data")
+        s, clock = _stream(str(tmp_path), tmp_path, idle_timeout_secs=0.5)
+        s.poll_now(), s.poll_now()
+        assert s.read(4) == b"data"
+        t0 = clock.t
+        assert s.read(4) == b""  # blocks polling until idle expiry
+        assert clock.t - t0 >= 0.5
+
+    def test_request_stop_eofs_promptly(self, tmp_path):
+        _write(tmp_path, "a.bin", b"data")
+        s, _ = _stream(str(tmp_path), tmp_path)  # idle_timeout 0: forever
+        s.poll_now(), s.poll_now()
+        s.request_stop()
+        assert s.read(4) == b"data"  # already-admitted bytes still served
+        assert s.read(4) == b""
+        assert s.stopped
+
+
+class TestManifestMode:
+    def test_lines_admit_on_existence(self, tmp_path):
+        a = _write(tmp_path, "a.bin", b"AA")
+        manifest = os.path.join(str(tmp_path), "manifest.txt")
+        with open(manifest, "w") as f:
+            f.write(f"# comment\n{a}\n{tmp_path}/missing.bin\n")
+        s, _ = _stream(manifest, tmp_path, idle_timeout_secs=1.0)
+        assert s.poll_now()  # no settling wait in manifest mode
+        assert s.files_admitted == [a]
+        assert s.read(2) == b"AA"
+        # The listed-but-absent file admits once it appears...
+        b = _write(tmp_path, "missing.bin", b"BB")
+        assert s.poll_now()
+        assert s.files_admitted == [a, b]
+        # ...and appended lines admit on the next poll.
+        c = _write(tmp_path, "c.bin", b"CC")
+        with open(manifest, "a") as f:
+            f.write(f"{c}\n")
+        assert s.poll_now()
+        assert _read_all(s) == b"BBCC"
