@@ -440,6 +440,92 @@ def online_publish_series() -> dict:
     }
 
 
+def serving_series() -> dict:
+    """Serving runtime under synthetic closed-loop load, with a hot swap
+    mid-run: per-request latency p50/p99, QPS, batch occupancy, and the
+    measured swap blackout (swap instant -> next completed flush).
+
+    Honesty fields mirror the train series: ``device_kind`` names the chip
+    that actually served, and ``load_kind`` labels the traffic as a
+    closed-loop synthetic driver (4 in-process clients, batch 1..32), NOT a
+    production trace — the occupancy/QPS are properties of that load."""
+    import shutil
+    import tempfile
+    import threading
+
+    import jax
+
+    from deepfm_tpu.serve import ServingEngine
+    from deepfm_tpu.train import Trainer
+    from deepfm_tpu.utils import export as export_lib
+
+    cfg = _bench_cfg()
+    trainer = Trainer(cfg)
+    state = trainer.init_state()
+    tmp = tempfile.mkdtemp(prefix="bench_serving_")
+    n_clients, run_secs, max_req = 4, 3.0, 32
+    orig_tf = export_lib._export_tf_savedmodel
+    export_lib._export_tf_savedmodel = lambda *a, **k: None  # not served
+    try:
+        # Two complete artifacts up front; the mid-run swap is then a pure
+        # pointer move + off-to-the-side load, as in production (the
+        # publisher never writes into a live artifact dir).
+        for version in ("1", "2"):
+            export_lib.export_serving(
+                trainer.model, state, cfg, os.path.join(tmp, version))
+        export_lib.write_latest(tmp, "1")
+        engine = ServingEngine.serve_latest(
+            tmp, poll_secs=0.05, max_batch=256, max_delay_ms=2.0)
+        stop = threading.Event()
+        failures = []
+
+        def client(seed):
+            rng = np.random.default_rng(seed)
+            while not stop.is_set():
+                n = int(rng.integers(1, max_req + 1))
+                ids = rng.integers(0, cfg.feature_size,
+                                   (n, cfg.field_size)).astype(np.int32)
+                vals = rng.normal(size=(n, cfg.field_size)).astype(np.float32)
+                try:
+                    engine.predict(ids, vals, timeout=30)
+                except Exception as e:  # noqa: BLE001 — the honesty counter
+                    failures.append(repr(e))
+        threads = [threading.Thread(target=client, args=(s,))
+                   for s in range(n_clients)]
+        for t in threads:
+            t.start()
+        try:
+            time.sleep(run_secs / 2)
+            export_lib.write_latest(tmp, "2")   # the hot swap, under load
+            time.sleep(run_secs / 2)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+        summary = engine.stats.summary()
+        swaps = engine.watcher.swap_count
+        swap_failures = engine.watcher.swap_failures
+        engine.close()
+    finally:
+        export_lib._export_tf_savedmodel = orig_tf
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "serving_p50_ms": summary["serving_p50_ms"],
+        "serving_p99_ms": summary["serving_p99_ms"],
+        "serving_qps": summary["serving_qps"],
+        "batch_occupancy_pct": summary["batch_occupancy_pct"],
+        "swap_blackout_ms": summary["swap_blackout_ms"],
+        "serving_requests": summary["serving_requests"],
+        "serving_failed": summary["serving_failed"] + len(failures),
+        "serving_overloads": summary["serving_overloads"],
+        "hot_swaps": swaps,
+        "swap_failures": swap_failures,
+        "clients": n_clients,
+        "load_kind": "synthetic-closed-loop",
+        "device_kind": jax.devices()[0].device_kind,
+    }
+
+
 def pallas_ab_device_ratio() -> dict:
     """Interleaved Pallas-vs-XLA A/B over the device-only staged multi-step
     (no transfer inside the timed window) — the regression canary for the
@@ -633,6 +719,12 @@ def main() -> None:
         print(f"bench: online publish series error: {e}", file=sys.stderr)
         online_publish = {"error": str(e)}
 
+    try:
+        serving = serving_series()
+    except Exception as e:
+        print(f"bench: serving series error: {e}", file=sys.stderr)
+        serving = {"error": str(e)}
+
     nominal_per_accel_baseline = 250_000.0 / 4.0
     # MFU from the device-only series (no transfer in the window): model
     # FLOPs/example x device-only examples/sec/chip over the chip's dense
@@ -669,6 +761,7 @@ def main() -> None:
         "pallas_ab_device": pallas_ab,
         "device_resident": device_resident,
         "online_publish": online_publish,
+        "serving": serving,
         "pallas_smoke": pallas_smoke,
     }
     if scaling is not None:

@@ -163,6 +163,23 @@ class Config:
     # (0 = wait forever; stop with SIGTERM).
     stream_poll_secs: float = 2.0
     stream_idle_timeout_secs: float = 0.0
+    # ---- serving runtime (serve/; README "Serving") ----
+    # Dynamic batcher policy: a flush fires when serve_max_batch rows are
+    # queued (max-batch policy) or serve_max_delay_ms elapsed since the
+    # FIRST queued request (deadline policy), whichever comes first.
+    serve_max_batch: int = 256
+    serve_max_delay_ms: float = 5.0
+    # Bounded request queue in ROWS; submit past it raises the typed
+    # ServerOverloaded (backpressure, never a hang). 0 = 8 * serve_max_batch.
+    serve_queue_rows: int = 0
+    # Batch-shape buckets as a comma list ("8,32,256"); every flush pads to
+    # the next bucket so at most len(buckets) predict programs compile.
+    # "" = the power-of-two ladder up to serve_max_batch.
+    serve_buckets: str = ""
+    # Frontend wedge watchdog: a predict or response write stalled past this
+    # many seconds aborts with exit code 43 (same contract as
+    # dispatch_timeout_s). 0 disables.
+    serve_timeout_s: float = 0.0
 
     # ---- mesh / parallelism (replaces TF_CONFIG + horovod knobs) ----
     mesh_data: int = 0                # data-parallel axis size (0 = all devices)
@@ -263,6 +280,25 @@ class Config:
         if self.online_mode and self.num_epochs != 1:
             raise ValueError(
                 "online_mode streams each shard once; num_epochs must be 1")
+        if self.serve_max_batch < 1:
+            raise ValueError("serve_max_batch must be >= 1")
+        if self.serve_max_delay_ms < 0:
+            raise ValueError("serve_max_delay_ms must be >= 0")
+        if self.serve_queue_rows < 0:
+            raise ValueError("serve_queue_rows must be >= 0 (0 = auto)")
+        if self.serve_queue_rows and self.serve_queue_rows < self.serve_max_batch:
+            raise ValueError(
+                "serve_queue_rows must hold at least one serve_max_batch")
+        if self.serve_timeout_s < 0:
+            raise ValueError("serve_timeout_s must be >= 0")
+        bucket_sizes = self.serve_bucket_sizes
+        if any(b < 1 for b in bucket_sizes):
+            raise ValueError(
+                f"serve_buckets must be positive ints, got {self.serve_buckets!r}")
+        if bucket_sizes and max(bucket_sizes) > self.serve_max_batch:
+            raise ValueError(
+                f"serve_buckets {self.serve_buckets!r} exceeds "
+                f"serve_max_batch={self.serve_max_batch}")
         if self.decoded_cache not in ("off", "ram", "disk"):
             raise ValueError(
                 f"decoded_cache must be off|ram|disk, got "
@@ -283,6 +319,10 @@ class Config:
     @property
     def dropout_rates(self) -> List[float]:
         return [float(x) for x in self.dropout.split(",") if x.strip()]
+
+    @property
+    def serve_bucket_sizes(self) -> List[int]:
+        return [int(x) for x in self.serve_buckets.split(",") if x.strip()]
 
     @property
     def channel_names(self) -> List[str]:

@@ -1,0 +1,28 @@
+"""TPU-native serving runtime: dynamic batching, bucketed shapes, hot swap.
+
+Layering (heaviest import last — clients can use :mod:`.frontend` and
+:mod:`.stats` without pulling jax):
+
+  * :mod:`.stats` — thread-safe latency/QPS/occupancy/swap accounting.
+  * :mod:`.engine` — bounded queue, dynamic batcher, bucketed predict,
+    response demux, hot swap via ``utils.export.LatestWatcher`` (the jax
+    import happens lazily at engine construction).
+  * :mod:`.frontend` — N client processes → one device-owning server over
+    ``data.shm_ring`` slab rings, with the exit-43 wedge contract.
+"""
+
+from .engine import ServeFuture, ServerOverloaded, ServingEngine
+from .frontend import (FrontendHandle, FrontendServer, ServingClient,
+                       client_main)
+from .stats import ServingStats
+
+__all__ = [
+    "FrontendHandle",
+    "FrontendServer",
+    "ServeFuture",
+    "ServerOverloaded",
+    "ServingClient",
+    "ServingEngine",
+    "ServingStats",
+    "client_main",
+]

@@ -1,0 +1,293 @@
+"""TPU-native serving engine: queue → dynamic batcher → bucketed predict.
+
+The reference delegated serving to TF-Serving (``2-hvd-gpu/...py:429-431``
+exports, a managed endpoint batches); this module is the in-repo engine that
+closes the train→publish→serve loop. One device-owning process runs:
+
+  * a **bounded request queue** — ``submit()`` admits up to
+    ``queue_rows`` pending rows and then raises a typed
+    :class:`ServerOverloaded` (backpressure a frontend can convert to a 429,
+    never a hang);
+  * a **dynamic batcher** — one flush thread waits for the first request,
+    then collects until ``max_batch`` rows arrive (max-batch policy,
+    preempts the deadline) or ``max_delay_ms`` elapses since the FIRST
+    queued request (deadline policy — a lone request is never stranded);
+  * **bucketed batch shapes** — each flush pads to the next bucket
+    (``utils.export.padded_predict``), so at most ``len(buckets)`` predict
+    programs ever compile no matter what sizes traffic brings;
+  * a **response demux** — padding stripped, per-request futures resolved
+    with per-request latency stamps (admission → resolution).
+
+Hot swap rides the existing :class:`~deepfm_tpu.utils.export.LatestWatcher`:
+pass a watcher as ``predict_fn`` (or use :meth:`ServingEngine.serve_latest`)
+and a newly published artifact is loaded off to the side and swapped in with
+one assignment — the flush that is executing keeps the function reference it
+already read, so in-flight batches finish on the old model and no request is
+ever dropped or failed by a swap. A failed load keeps the current model
+(``LatestWatcher.swap_failures`` counts it).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from .stats import ServingStats
+
+
+class ServerOverloaded(RuntimeError):
+    """The bounded request queue is full (or the engine is shut down).
+
+    The typed backpressure signal: callers retry with backoff or shed load;
+    the engine never blocks a submitter and never silently drops a request.
+    """
+
+
+class ServeFuture:
+    """One request's pending result: resolved by the batcher's demux."""
+
+    __slots__ = ("ids", "vals", "n", "t_enqueue", "latency_ms",
+                 "_event", "_probs", "_error")
+
+    def __init__(self, ids: np.ndarray, vals: np.ndarray, t_enqueue: float):
+        self.ids = ids
+        self.vals = vals
+        self.n = int(ids.shape[0])
+        self.t_enqueue = t_enqueue
+        self.latency_ms: Optional[float] = None
+        self._event = threading.Event()
+        self._probs: Optional[np.ndarray] = None
+        self._error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def set_result(self, probs: np.ndarray, latency_ms: float) -> None:
+        self._probs = probs
+        self.latency_ms = latency_ms
+        self._event.set()
+
+    def set_error(self, exc: BaseException) -> None:
+        self._error = exc
+        self._event.set()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block for the probs ``[n]``; raises the predict error if the
+        flush failed, TimeoutError if not resolved in ``timeout``."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"request of {self.n} rows unresolved after {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._probs
+
+
+class ServingEngine:
+    """Bounded queue + dynamic batcher + bucketed jitted predict + demux."""
+
+    def __init__(self, predict_fn: Callable[[np.ndarray, np.ndarray],
+                                            np.ndarray], *,
+                 max_batch: int = 256, max_delay_ms: float = 5.0,
+                 queue_rows: int = 0,
+                 buckets: Optional[Sequence[int]] = None,
+                 stats: Optional[ServingStats] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 start: bool = True):
+        from ..utils import export as export_lib  # lazy: jax-heavy
+        self._export = export_lib
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if max_delay_ms < 0:
+            raise ValueError(f"max_delay_ms must be >= 0, got {max_delay_ms}")
+        self._fn = predict_fn
+        self.max_batch = int(max_batch)
+        self.max_delay_s = float(max_delay_ms) / 1000.0
+        self.queue_rows = int(queue_rows) if queue_rows else 8 * self.max_batch
+        if self.queue_rows < self.max_batch:
+            raise ValueError(
+                f"queue_rows ({self.queue_rows}) must hold at least one "
+                f"max_batch ({self.max_batch})")
+        bucket_src = (buckets if buckets is not None
+                      else export_lib.serving_buckets(self.max_batch))
+        self.buckets = tuple(sorted({int(b) for b in bucket_src}
+                                    | {self.max_batch}))
+        if self.buckets[0] < 1:
+            raise ValueError(f"buckets must be positive, got {buckets}")
+        self.stats = stats if stats is not None else ServingStats(clock)
+        self._clock = clock
+        self._cond = threading.Condition()
+        self._queue: deque = deque()
+        self._queued_rows = 0
+        self._closing = False
+        self._watcher = None        # owned LatestWatcher (serve_latest)
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self.start()
+
+    # ------------------------------------------------------- construction
+    @classmethod
+    def from_config(cls, cfg: Any, predict_fn: Callable,
+                    **kw: Any) -> "ServingEngine":
+        """Engine with the ``--serve_*`` policy of ``cfg``."""
+        kw.setdefault("max_batch", cfg.serve_max_batch)
+        kw.setdefault("max_delay_ms", cfg.serve_max_delay_ms)
+        kw.setdefault("queue_rows", cfg.serve_queue_rows)
+        bucket_list = cfg.serve_bucket_sizes
+        if bucket_list:
+            kw.setdefault("buckets", bucket_list)
+        return cls(predict_fn, **kw)
+
+    @classmethod
+    def serve_latest(cls, publish_dir: str, *, poll_secs: float = 2.0,
+                     watcher_kw: Optional[dict] = None,
+                     **kw: Any) -> "ServingEngine":
+        """Engine following ``<publish_dir>/LATEST`` with hot swap.
+
+        The watcher is owned: closed with the engine, and every swap it
+        performs is stamped into the engine's stats (the blackout series).
+        """
+        from ..utils import export as export_lib  # lazy: jax-heavy
+        stats = kw.pop("stats", None) or ServingStats(
+            kw.get("clock", time.monotonic))
+        watcher = export_lib.watch_latest(
+            publish_dir, poll_secs=poll_secs,
+            on_swap=lambda path: stats.record_swap(),
+            **(watcher_kw or {}))
+        engine = cls(watcher, stats=stats, **kw)
+        engine._watcher = watcher
+        return engine
+
+    @property
+    def watcher(self):
+        return self._watcher
+
+    # ------------------------------------------------------------- client
+    def submit(self, feat_ids: np.ndarray,
+               feat_vals: np.ndarray) -> ServeFuture:
+        """Enqueue one request ``(ids[n,F], vals[n,F])``; returns its
+        future. Raises :class:`ServerOverloaded` when the queue is full or
+        the engine is shutting down, ValueError on malformed shapes."""
+        ids = np.asarray(feat_ids)
+        vals = np.asarray(feat_vals)
+        if ids.ndim != 2 or vals.shape != ids.shape:
+            raise ValueError(
+                f"expected feat_ids/feat_vals of one [n, F] shape, got "
+                f"{ids.shape} / {vals.shape}")
+        n = int(ids.shape[0])
+        if not 1 <= n <= self.max_batch:
+            raise ValueError(
+                f"request of {n} rows outside 1..max_batch={self.max_batch} "
+                "(split oversized requests client-side)")
+        fut = ServeFuture(ids, vals, self._clock())
+        with self._cond:
+            if self._closing:
+                self.stats.record_overload()
+                raise ServerOverloaded("serving engine is shut down")
+            if self._queued_rows + n > self.queue_rows:
+                self.stats.record_overload()
+                raise ServerOverloaded(
+                    f"request queue full ({self._queued_rows} rows pending, "
+                    f"limit {self.queue_rows}); retry with backoff")
+            self._queue.append(fut)
+            self._queued_rows += n
+            self._cond.notify_all()
+        return fut
+
+    def predict(self, feat_ids: np.ndarray, feat_vals: np.ndarray,
+                timeout: Optional[float] = None) -> np.ndarray:
+        """Synchronous convenience: ``submit().result()``."""
+        return self.submit(feat_ids, feat_vals).result(timeout)
+
+    # ------------------------------------------------------------ batcher
+    def start(self) -> "ServingEngine":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="serving-batcher", daemon=True)
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while True:
+            batch, rows = self._collect()
+            if not batch:
+                return  # closed and drained
+            self._flush(batch, rows)
+
+    def _collect(self) -> tuple:
+        """Block until a flush is due; pop and return it. Empty = exit."""
+        with self._cond:
+            while not self._queue and not self._closing:
+                self._cond.wait()
+            if not self._queue:
+                return [], 0
+            if not self._closing and self.max_delay_s > 0:
+                # Deadline anchored at the FIRST queued request: a single
+                # request waits at most max_delay_ms. A full max_batch of
+                # rows arriving earlier preempts the deadline.
+                deadline = self._queue[0].t_enqueue + self.max_delay_s
+                while self._queued_rows < self.max_batch \
+                        and not self._closing:
+                    remaining = deadline - self._clock()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(timeout=remaining)
+            batch: List[ServeFuture] = []
+            rows = 0
+            while self._queue and rows + self._queue[0].n <= self.max_batch:
+                fut = self._queue.popleft()
+                rows += fut.n
+                batch.append(fut)
+            self._queued_rows -= rows
+            return batch, rows
+
+    def _flush(self, batch: List[ServeFuture], rows: int) -> None:
+        if len(batch) == 1:
+            ids, vals = batch[0].ids, batch[0].vals
+        else:
+            ids = np.concatenate([f.ids for f in batch])
+            vals = np.concatenate([f.vals for f in batch])
+        bucket = self._export.next_bucket(rows, self.buckets)
+        try:
+            probs = np.asarray(self._export.padded_predict(
+                self._fn, ids, vals, self.buckets)).reshape(-1)
+        except Exception as exc:  # noqa: BLE001 — forwarded per-request
+            for fut in batch:
+                self.stats.record_request_failed()
+                fut.set_error(exc)
+            return
+        now = self._clock()
+        off = 0
+        for fut in batch:
+            fut.set_result(probs[off:off + fut.n],
+                           latency_ms=1000.0 * (now - fut.t_enqueue))
+            off += fut.n
+            self.stats.record_request_done(fut.latency_ms)
+        self.stats.record_flush(rows, bucket, full=rows >= self.max_batch)
+
+    # ---------------------------------------------------------- lifecycle
+    @property
+    def pending_rows(self) -> int:
+        with self._cond:
+            return self._queued_rows
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Stop admitting, DRAIN the queue (every admitted request gets its
+        response), join the batcher, close an owned watcher."""
+        with self._cond:
+            self._closing = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+        if self._watcher is not None:
+            self._watcher.close()
+
+    def __enter__(self) -> "ServingEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,131 @@
+"""Serving-side observability: latency/QPS/occupancy/swap accounting.
+
+The serving mirror of ``data.health.DataHealth`` / ``train.guard.TrainHealth``
+— one thread-safe object every layer of the serving runtime stamps into, and
+one ``summary()`` dict the drill and ``bench.py``'s ``serving`` series read.
+All timestamps come from an injectable ``clock`` so tests are sleep-free.
+
+What the fields mean (the contract ``SERVING_r0*.json`` reports):
+
+  * ``serving_p50_ms`` / ``serving_p99_ms`` — per-request latency from
+    ``submit()`` admission to future resolution (queue wait + batch wait +
+    predict + demux; the number a client actually experiences).
+  * ``serving_qps`` — completed requests over the first→last completion
+    window (steady-state, not including warm-up idle).
+  * ``batch_occupancy_pct`` — real rows over padded bucket rows across all
+    flushes: 100% means every flush exactly filled its bucket; low values
+    mean the deadline fires before batches fill (see TUNING §2.10).
+  * ``swap_blackout_ms`` — worst-case time from a hot model swap to the
+    next completed flush. Near-zero is the design goal: the new model loads
+    off to the side, so a swap should never stall the response stream.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+def _pct(values: List[float], q: float) -> Optional[float]:
+    if not values:
+        return None
+    return float(np.percentile(np.asarray(values, np.float64), q))
+
+
+class ServingStats:
+    """Thread-safe counters + latency reservoir for one serving engine."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.requests_completed = 0
+        self.requests_failed = 0
+        self.rows_completed = 0
+        self.overloads = 0            # typed ServerOverloaded rejections
+        self.flushes = 0
+        self.padded_rows = 0          # sum of bucket sizes over flushes
+        self.real_rows = 0            # sum of real rows over flushes
+        self.max_batch_flushes = 0    # flushes that filled max_batch rows
+        self.deadline_flushes = 0     # flushes fired by the delay deadline
+        self.latencies_ms: List[float] = []
+        self.swap_blackouts_ms: List[float] = []
+        self._first_done: Optional[float] = None
+        self._last_done: Optional[float] = None
+        self._swap_at: Optional[float] = None
+
+    # ------------------------------------------------------------- stamps
+    def record_request_done(self, latency_ms: float) -> None:
+        with self._lock:
+            self.requests_completed += 1
+            self.latencies_ms.append(float(latency_ms))
+
+    def record_request_failed(self) -> None:
+        with self._lock:
+            self.requests_failed += 1
+
+    def record_overload(self) -> None:
+        with self._lock:
+            self.overloads += 1
+
+    def record_flush(self, rows: int, bucket: int, *,
+                     full: bool = False) -> None:
+        """One batch flushed through predict: ``rows`` real rows padded to
+        ``bucket``. ``full`` = the max-batch policy fired (vs deadline)."""
+        now = self._clock()
+        with self._lock:
+            self.flushes += 1
+            self.real_rows += int(rows)
+            self.rows_completed += int(rows)
+            self.padded_rows += int(bucket)
+            if full:
+                self.max_batch_flushes += 1
+            else:
+                self.deadline_flushes += 1
+            if self._first_done is None:
+                self._first_done = now
+            if self._swap_at is not None:
+                self.swap_blackouts_ms.append(
+                    1000.0 * max(0.0, now - self._swap_at))
+                self._swap_at = None
+            self._last_done = now
+
+    def record_swap(self) -> None:
+        """A hot model swap happened; the next flush closes the blackout
+        window (time the response stream went without a completion)."""
+        with self._lock:
+            if self._swap_at is None:
+                self._swap_at = self._clock()
+
+    # ------------------------------------------------------------ summary
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            window = None
+            if (self._first_done is not None and self._last_done is not None
+                    and self._last_done > self._first_done):
+                window = self._last_done - self._first_done
+            qps = (self.requests_completed / window if window else None)
+            occupancy = (100.0 * self.real_rows / self.padded_rows
+                         if self.padded_rows else None)
+            return {
+                "serving_requests": self.requests_completed,
+                "serving_failed": self.requests_failed,
+                "serving_overloads": self.overloads,
+                "serving_rows": self.rows_completed,
+                "serving_p50_ms": _pct(self.latencies_ms, 50),
+                "serving_p99_ms": _pct(self.latencies_ms, 99),
+                "serving_qps": round(qps, 1) if qps is not None else None,
+                "batch_occupancy_pct": (round(occupancy, 2)
+                                        if occupancy is not None else None),
+                "serving_flushes": self.flushes,
+                "serving_rows_per_flush": (
+                    round(self.real_rows / self.flushes, 2)
+                    if self.flushes else None),
+                "serving_max_batch_flushes": self.max_batch_flushes,
+                "serving_deadline_flushes": self.deadline_flushes,
+                "swap_blackout_ms": (
+                    round(max(self.swap_blackouts_ms), 3)
+                    if self.swap_blackouts_ms else None),
+            }

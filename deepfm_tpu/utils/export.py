@@ -19,7 +19,7 @@ from __future__ import annotations
 import json
 import threading
 import time
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -197,8 +197,103 @@ def _export_tf_savedmodel(serve: Callable, params, model_state, cfg: Config,
     ulog.info(f"wrote TF SavedModel to {sm_dir}")
 
 
-def load_serving(artifact_dir: str) -> Callable[[np.ndarray, np.ndarray], np.ndarray]:
+# --------------------------------------------------------------------------
+# Bucketed prediction: the explicit per-shape compile cache
+# --------------------------------------------------------------------------
+#
+# Both reload paths below compile one program per distinct batch shape they
+# see (``exported.call`` specializes the symbolic batch dim per concrete
+# shape; ``jax.jit`` caches per shape) — an implicit, unbounded compile
+# cache. A serving engine flushing arbitrary batch sizes would compile
+# arbitrarily many variants; bucketing makes the cache explicit and bounded:
+# every call pads to the next bucket size, so at most ``len(buckets)``
+# programs ever compile, and which sizes compile is a deployment decision
+# instead of an accident of traffic.
+
+def serving_buckets(max_batch: int) -> Tuple[int, ...]:
+    """Power-of-two bucket ladder ``(1, 2, 4, ..., max_batch)``.
+
+    ``max_batch`` itself is always the last bucket, even when it is not a
+    power of two — the engine's largest flush must have a home.
+    """
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    buckets = []
+    b = 1
+    while b < max_batch:
+        buckets.append(b)
+        b <<= 1
+    buckets.append(int(max_batch))
+    return tuple(buckets)
+
+
+def next_bucket(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= ``n`` (buckets ascending)."""
+    if n < 1:
+        raise ValueError(f"batch of {n} rows cannot be bucketed")
+    for b in buckets:
+        if b >= n:
+            return int(b)
+    raise ValueError(
+        f"batch of {n} rows exceeds the largest bucket ({buckets[-1]}); "
+        "raise serve_max_batch or split the request")
+
+
+def padded_predict(fn: Callable[[np.ndarray, np.ndarray], np.ndarray],
+                   feat_ids: np.ndarray, feat_vals: np.ndarray,
+                   buckets: Sequence[int]) -> np.ndarray:
+    """Run ``fn`` on the batch padded up to its bucket; return the real rows.
+
+    Pad rows are zeros (id 0 is a valid embedding row; the serve path runs
+    ``train=False`` so no batch statistic couples rows) and their outputs
+    are sliced away before returning — output is row-for-row equal to the
+    unpadded call (pinned by ``tests/test_serving.py``).
+    """
+    n = int(feat_ids.shape[0])
+    b = next_bucket(n, buckets)
+    if b == n:
+        return np.asarray(fn(feat_ids, feat_vals))
+    ids = np.zeros((b,) + feat_ids.shape[1:], feat_ids.dtype)
+    vals = np.zeros((b,) + feat_vals.shape[1:], feat_vals.dtype)
+    ids[:n] = feat_ids
+    vals[:n] = feat_vals
+    return np.asarray(fn(ids, vals))[:n]
+
+
+class BucketedPredict:
+    """``load_serving``-shaped callable with the bounded compile cache.
+
+    Wraps a raw ``f(feat_ids, feat_vals) -> probs`` so only bucket shapes
+    ever reach it. ``calls_per_bucket`` is observability for the serving
+    stats (which bucket a deployment actually exercises).
+    """
+
+    def __init__(self, fn: Callable, buckets: Sequence[int]):
+        bs = tuple(sorted({int(b) for b in buckets}))
+        if not bs or bs[0] < 1:
+            raise ValueError(f"buckets must be positive ints, got {buckets}")
+        self.fn = fn
+        self.buckets = bs
+        self.calls_per_bucket: Dict[int, int] = {b: 0 for b in bs}
+
+    @property
+    def max_batch(self) -> int:
+        return self.buckets[-1]
+
+    def __call__(self, feat_ids: np.ndarray,
+                 feat_vals: np.ndarray) -> np.ndarray:
+        self.calls_per_bucket[next_bucket(len(feat_ids), self.buckets)] += 1
+        return padded_predict(self.fn, feat_ids, feat_vals, self.buckets)
+
+
+def load_serving(artifact_dir: str, *,
+                 buckets: Optional[Sequence[int]] = None
+                 ) -> Callable[[np.ndarray, np.ndarray], np.ndarray]:
     """Reload a servable artifact as ``f(feat_ids, feat_vals) -> probs``.
+
+    With ``buckets`` the result is a :class:`BucketedPredict` — every call
+    pads to the next bucket size so at most ``len(buckets)`` predict
+    programs ever compile (the serving engine's shape policy).
 
     Raises :class:`ArtifactIncomplete` when the dir lacks its completion
     marker — the dir is mid-write, or an export crashed into it. Callers
@@ -226,15 +321,16 @@ def load_serving(artifact_dir: str) -> Callable[[np.ndarray, np.ndarray], np.nda
             return np.asarray(exported.call(
                 params, model_state, feat_ids.astype(np.int32),
                 feat_vals.astype(np.float32)))
-        return serve
+    else:
+        # Fallback: rebuild from config (params-only artifact).
+        from ..models import get_model
+        model = get_model(cfg)
+        fn = jax.jit(_serving_fn(model, cfg))
 
-    # Fallback: rebuild from config (params-only artifact).
-    from ..models import get_model
-    model = get_model(cfg)
-    fn = jax.jit(_serving_fn(model, cfg))
-
-    def serve(feat_ids: np.ndarray, feat_vals: np.ndarray) -> np.ndarray:
-        return np.asarray(fn(params, model_state, feat_ids, feat_vals))
+        def serve(feat_ids: np.ndarray, feat_vals: np.ndarray) -> np.ndarray:
+            return np.asarray(fn(params, model_state, feat_ids, feat_vals))
+    if buckets is not None:
+        return BucketedPredict(serve, buckets)
     return serve
 
 
@@ -290,6 +386,11 @@ class LatestWatcher:
         self._fn: Optional[Callable] = None
         self.current_path: Optional[str] = None
         self.swap_count = 0
+        # Failed swap attempts (torn/marker-less/vanished artifact seen at
+        # LATEST): the current model stayed live each time. A counter, not
+        # just a warning — a serving drill asserting "zero dropped requests
+        # across N swaps" also wants to know how many swaps never happened.
+        self.swap_failures = 0
         self._thread: Optional[threading.Thread] = None
         self.check_once()
         if start:
@@ -305,6 +406,7 @@ class LatestWatcher:
         try:
             fn = self._loader(path)
         except (ArtifactIncomplete, OSError, ValueError) as e:
+            self.swap_failures += 1
             ulog.warning(f"hot-swap to {path} deferred ({e}); "
                          "keeping current model")
             return False
@@ -323,6 +425,7 @@ class LatestWatcher:
             try:
                 self.check_once()
             except Exception as e:  # never kill the serving thread
+                self.swap_failures += 1
                 ulog.warning(f"LATEST poll failed ({e}); retrying")
 
     def __call__(self, feat_ids: np.ndarray,

@@ -57,6 +57,10 @@ def pytest_configure(config):
         "markers",
         "preempt: preemption/self-healing runtime tests (signal-driven "
         "checkpointing, NaN guard policies, stall watchdogs, supervisor)")
+    config.addinivalue_line(
+        "markers",
+        "serving: serving-runtime tests (dynamic batcher, bucketed predict, "
+        "hot swap, shared-memory frontend)")
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,245 @@
+"""Multi-process serving frontend tests.
+
+The ring protocol, demux, backpressure, crash-safe shutdown, and wedge
+detection all run in-process over ``THREAD_CTX`` rings (deterministic,
+sleep-free where possible); one slow-marked test spawns REAL client
+processes against a real server thread — the zero→aha path of the
+multi-process frontend.
+"""
+
+import multiprocessing as mp
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deepfm_tpu.data.shm_ring import THREAD_CTX
+from deepfm_tpu.serve import (FrontendServer, ServerOverloaded,
+                              ServingClient, ServingEngine)
+from deepfm_tpu.serve.frontend import client_main
+
+pytestmark = pytest.mark.serving
+
+FIELD_SIZE = 3
+
+
+def _rows(n, base=0):
+    ids = np.full((n, FIELD_SIZE), base, np.int32)
+    vals = np.ones((n, FIELD_SIZE), np.float32)
+    return ids, vals
+
+
+def base_predict(feat_ids, feat_vals):
+    return feat_ids[:, 0].astype(np.float32) + 0.5 * feat_vals[:, 0]
+
+
+@pytest.fixture
+def engine():
+    eng = ServingEngine(base_predict, max_batch=8, max_delay_ms=2)
+    yield eng
+    eng.close(timeout=5)
+
+
+def _serve_bg(srv):
+    t = threading.Thread(target=srv.serve, daemon=True)
+    t.start()
+    return t
+
+
+class TestFrontendInProcess:
+    def test_two_clients_end_to_end(self, engine):
+        srv = FrontendServer(engine, 2, field_size=FIELD_SIZE,
+                             ctx=THREAD_CTX)
+        t = _serve_bg(srv)
+        try:
+            with ServingClient(srv.handle(0)) as c0, \
+                    ServingClient(srv.handle(1)) as c1:
+                p0 = c0.predict(*_rows(4, base=10), timeout=10)
+                p1 = c1.predict(*_rows(2, base=20), timeout=10)
+                np.testing.assert_array_equal(p0, np.full(4, 10.5, np.float32))
+                np.testing.assert_array_equal(p1, np.full(2, 20.5, np.float32))
+            t.join(timeout=10)          # both byes -> server exits
+            assert not t.is_alive()
+            assert srv.responses_sent == 2 and srv.errors_sent == 0
+        finally:
+            srv.stop()
+            srv.close()
+
+    def test_pipelined_requests_demux_by_req_id(self, engine):
+        srv = FrontendServer(engine, 1, field_size=FIELD_SIZE,
+                             ctx=THREAD_CTX)
+        t = _serve_bg(srv)
+        try:
+            with ServingClient(srv.handle(0)) as c:
+                r1 = c.submit(*_rows(1, base=1), timeout=5)
+                r2 = c.submit(*_rows(2, base=2), timeout=5)
+                r3 = c.submit(*_rows(3, base=3), timeout=5)
+                # Collect out of submission order: demux must hold r2/r3
+                # aside while r1's probs come back, and vice versa.
+                np.testing.assert_array_equal(
+                    c.recv(r3, timeout=10), np.full(3, 3.5, np.float32))
+                np.testing.assert_array_equal(
+                    c.recv(r1, timeout=10), np.full(1, 1.5, np.float32))
+                np.testing.assert_array_equal(
+                    c.recv(r2, timeout=10), np.full(2, 2.5, np.float32))
+        finally:
+            srv.stop()
+            t.join(timeout=10)
+            srv.close()
+
+    def test_engine_overload_comes_back_typed(self):
+        # start=False engine: nothing drains, so the queue bound trips and
+        # the server must forward the typed rejection over the ring.
+        eng = ServingEngine(base_predict, max_batch=2, queue_rows=2,
+                            start=False)
+        srv = FrontendServer(eng, 1, field_size=FIELD_SIZE, ctx=THREAD_CTX)
+        t = _serve_bg(srv)
+        try:
+            with ServingClient(srv.handle(0)) as c:
+                r1 = c.submit(*_rows(2), timeout=5)   # fills the queue
+                r2 = c.submit(*_rows(1), timeout=5)   # over the bound
+                with pytest.raises(ServerOverloaded, match="queue full"):
+                    c.recv(r2, timeout=10)
+                assert srv.errors_sent == 1
+                eng.start()                           # drain r1 normally
+                assert c.recv(r1, timeout=10).shape == (2,)
+        finally:
+            srv.stop()
+            t.join(timeout=10)
+            srv.close()
+            eng.close(timeout=5)
+
+    def test_request_ring_full_is_typed(self, engine):
+        srv = FrontendServer(engine, 1, field_size=FIELD_SIZE,
+                             ctx=THREAD_CTX, capacity=2)
+        # Server NOT running: the ring's 2 slots fill, then acquire times
+        # out and submit must raise the typed error, not hang.
+        c = ServingClient(srv.handle(0))
+        try:
+            c.submit(*_rows(1), timeout=0)
+            c.submit(*_rows(1), timeout=0)
+            with pytest.raises(ServerOverloaded, match="request ring full"):
+                c.submit(*_rows(1), timeout=0)
+        finally:
+            c.close()
+            srv.close()
+
+    def test_client_validates_shapes(self, engine):
+        srv = FrontendServer(engine, 1, field_size=FIELD_SIZE,
+                             ctx=THREAD_CTX)
+        c = ServingClient(srv.handle(0))
+        try:
+            with pytest.raises(ValueError, match="feat_ids/feat_vals"):
+                c.submit(np.zeros((2, 9), np.int32),
+                         np.zeros((2, 9), np.float32))
+            with pytest.raises(ValueError, match="outside 1"):
+                c.submit(*_rows(srv.max_rows + 1))
+        finally:
+            c.close()
+            srv.close()
+
+    def test_dead_client_without_farewell_is_retired(self, engine):
+        """A client that dies mid-conversation (no ``bye``) must not wedge
+        the server: once its response ring backs up and the liveness probe
+        says gone, its responses are dropped and the loop moves on."""
+        alive = {"flag": True}
+        srv = FrontendServer(
+            engine, 1, field_size=FIELD_SIZE, ctx=THREAD_CTX, capacity=2,
+            client_alive=lambda cid: alive["flag"])
+        t = _serve_bg(srv)
+        try:
+            c = ServingClient(srv.handle(0))
+            # Three pipelined requests, never read: responses 1+2 fill the
+            # ring, response 3 blocks -> probe -> retire.
+            for _ in range(3):
+                c.submit(*_rows(1), timeout=5)
+            deadline = time.monotonic() + 10
+            while srv.responses_sent < 2 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            alive["flag"] = False          # the "process" dies
+            t.join(timeout=10)             # server retires it and exits
+            assert not t.is_alive()
+            assert srv.dropped_dead_client >= 1
+        finally:
+            srv.stop()
+            srv.close()
+
+    def test_wedged_predict_trips_watchdog(self):
+        """A predict that never returns stops the beat stream; the watchdog
+        aborts with the exit-43 contract (injected abort here)."""
+        release = threading.Event()
+
+        def wedged(ids, vals):
+            release.wait(30)
+            return base_predict(ids, vals)
+
+        eng = ServingEngine(wedged, max_batch=4, max_delay_ms=1)
+        fired = []
+        srv = FrontendServer(
+            eng, 1, field_size=FIELD_SIZE, ctx=THREAD_CTX, timeout_s=0.3,
+            abort=lambda dump: (fired.append(dump), srv.stop()))
+        t = _serve_bg(srv)
+        try:
+            c = ServingClient(srv.handle(0))
+            c.submit(*_rows(1), timeout=5)
+            t.join(timeout=15)
+            assert not t.is_alive(), "watchdog never fired"
+            assert fired and "serving-frontend" in fired[0]
+        finally:
+            release.set()
+            srv.stop()
+            srv.close()
+            eng.close(timeout=5)
+
+    def test_idle_server_does_not_false_trip(self, engine):
+        """No traffic is not a wedge: the loop beats while idle, so a quiet
+        server survives many timeout windows."""
+        fired = []
+        srv = FrontendServer(
+            engine, 1, field_size=FIELD_SIZE, ctx=THREAD_CTX, timeout_s=0.2,
+            abort=lambda dump: fired.append(dump))
+        t = _serve_bg(srv)
+        try:
+            time.sleep(0.7)                # several timeout windows of idle
+            assert not fired
+            with ServingClient(srv.handle(0)) as c:
+                assert c.predict(*_rows(2), timeout=10).shape == (2,)
+        finally:
+            srv.stop()
+            t.join(timeout=10)
+            srv.close()
+
+
+@pytest.mark.slow
+class TestRealProcesses:
+    def test_spawned_clients_round_trip(self):
+        """The production shape: spawn-context client PROCESSES against the
+        device-owning server, zero failures."""
+        ctx = mp.get_context("spawn")
+        eng = ServingEngine(base_predict, max_batch=16, max_delay_ms=3)
+        srv = FrontendServer(eng, 2, field_size=FIELD_SIZE, ctx=ctx,
+                             slab_records=8)
+        t = _serve_bg(srv)
+        procs = [
+            ctx.Process(target=client_main,
+                        args=(srv.handle(i), 20, 8, 100, 1000 + i))
+            for i in range(2)
+        ]
+        try:
+            for p in procs:
+                p.start()
+            for p in procs:
+                p.join(timeout=120)
+                assert p.exitcode == 0, f"client failed: {p.exitcode}"
+            t.join(timeout=30)
+            assert not t.is_alive()
+            assert srv.responses_sent == 40 and srv.errors_sent == 0
+            assert eng.stats.requests_failed == 0
+        finally:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            srv.stop()
+            srv.close()
+            eng.close(timeout=5)

@@ -1,0 +1,422 @@
+"""Serving-runtime tests: bucket math, padded-predict parity, dynamic
+batcher policy edges (single-request deadline, queue-full backpressure,
+max-batch preemption, drain-on-shutdown), response demux + latency stamps,
+hot swap under load (zero dropped/failed requests), the torn-artifact
+``swap_failures`` regression, and an end-to-end smoke over a REAL exported
+artifact (bucketed output bit-equal to the unpadded call)."""
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+from deepfm_tpu.config import Config
+from deepfm_tpu.serve import (ServerOverloaded, ServingEngine, ServingStats)
+from deepfm_tpu.utils import export as export_lib
+
+pytestmark = pytest.mark.serving
+
+FIELD_SIZE = 5
+
+
+def _rows(n, base=0):
+    ids = (base + np.arange(n * FIELD_SIZE, dtype=np.int32)
+           ).reshape(n, FIELD_SIZE) % 120
+    vals = np.ones((n, FIELD_SIZE), np.float32)
+    return ids, vals
+
+
+def first_col_predict(feat_ids, feat_vals):
+    """Row-local fake model: prob = f(row) only, like the real serve fn."""
+    return feat_ids[:, 0].astype(np.float32) * 0.001 + feat_vals[:, 0] * 0.1
+
+
+# ---------------------------------------------------------------------------
+# Bucket math + padded predict (satellite 1)
+# ---------------------------------------------------------------------------
+
+class TestBuckets:
+    def test_pow2_ladder(self):
+        assert export_lib.serving_buckets(8) == (1, 2, 4, 8)
+        assert export_lib.serving_buckets(1) == (1,)
+
+    def test_non_pow2_max_is_kept(self):
+        assert export_lib.serving_buckets(12) == (1, 2, 4, 8, 12)
+
+    def test_next_bucket(self):
+        buckets = (1, 2, 4, 8)
+        assert [export_lib.next_bucket(n, buckets)
+                for n in (1, 2, 3, 5, 8)] == [1, 2, 4, 8, 8]
+        with pytest.raises(ValueError, match="exceeds the largest"):
+            export_lib.next_bucket(9, buckets)
+        with pytest.raises(ValueError):
+            export_lib.next_bucket(0, buckets)
+
+    def test_padded_predict_pads_and_strips(self):
+        seen = []
+
+        def spy(ids, vals):
+            seen.append(ids.shape[0])
+            return first_col_predict(ids, vals)
+
+        ids, vals = _rows(5)
+        out = export_lib.padded_predict(spy, ids, vals, (1, 2, 4, 8))
+        assert seen == [8]                       # padded to the bucket...
+        assert out.shape == (5,)                 # ...pad rows stripped
+        np.testing.assert_array_equal(out, first_col_predict(ids, vals))
+
+    def test_exact_bucket_size_skips_padding(self):
+        seen = []
+
+        def spy(ids, vals):
+            seen.append(ids.shape[0])
+            return first_col_predict(ids, vals)
+
+        ids, vals = _rows(4)
+        export_lib.padded_predict(spy, ids, vals, (1, 2, 4, 8))
+        assert seen == [4]
+
+    def test_bucketed_predict_counts_calls(self):
+        bp = export_lib.BucketedPredict(first_col_predict, (2, 8))
+        assert bp.max_batch == 8
+        for n in (1, 2, 3, 8):
+            ids, vals = _rows(n)
+            np.testing.assert_array_equal(
+                bp(ids, vals), first_col_predict(ids, vals))
+        assert bp.calls_per_bucket == {2: 2, 8: 2}
+
+
+# ---------------------------------------------------------------------------
+# Batcher policy edges (satellite 3)
+# ---------------------------------------------------------------------------
+
+class TestBatcherPolicy:
+    def test_single_request_deadline_fires(self):
+        """A lone request is never stranded: the deadline (anchored at ITS
+        enqueue time) flushes it even though the batch never fills."""
+        eng = ServingEngine(first_col_predict, max_batch=64, max_delay_ms=20)
+        try:
+            ids, vals = _rows(1)
+            probs = eng.predict(ids, vals, timeout=10)
+            np.testing.assert_array_equal(probs, first_col_predict(ids, vals))
+            assert eng.stats.deadline_flushes == 1
+            assert eng.stats.max_batch_flushes == 0
+        finally:
+            eng.close()
+
+    def test_queue_full_is_typed_not_a_hang(self):
+        # start=False: nothing drains, so the bound must trip synchronously.
+        eng = ServingEngine(first_col_predict, max_batch=4, queue_rows=8,
+                            start=False)
+        for _ in range(2):
+            eng.submit(*_rows(4))
+        with pytest.raises(ServerOverloaded, match="queue full"):
+            eng.submit(*_rows(1))
+        assert eng.stats.overloads == 1
+        assert eng.pending_rows == 8
+
+    def test_max_batch_flush_preempts_deadline(self):
+        """max_batch rows arriving early flush immediately — the 10s
+        deadline never gets a chance (the test would time out if it did)."""
+        eng = ServingEngine(first_col_predict, max_batch=8,
+                            max_delay_ms=10_000)
+        try:
+            futs = [eng.submit(*_rows(4, base=i)) for i in range(2)]
+            for f in futs:
+                f.result(timeout=5)
+            assert eng.stats.max_batch_flushes == 1
+            assert eng.stats.deadline_flushes == 0
+        finally:
+            eng.close()
+
+    def test_close_drains_queue(self):
+        """Shutdown resolves every admitted request before the batcher
+        exits — and later submits get the typed rejection."""
+        eng = ServingEngine(first_col_predict, max_batch=64,
+                            max_delay_ms=60_000, start=False)
+        futs = [eng.submit(*_rows(3, base=i)) for i in range(5)]
+        eng.start()
+        eng.close(timeout=10)
+        for f in futs:
+            assert f.result(timeout=0).shape == (3,)
+        with pytest.raises(ServerOverloaded, match="shut down"):
+            eng.submit(*_rows(1))
+
+    def test_oversized_and_malformed_requests_rejected(self):
+        eng = ServingEngine(first_col_predict, max_batch=4, start=False)
+        with pytest.raises(ValueError, match="outside 1..max_batch"):
+            eng.submit(*_rows(5))
+        with pytest.raises(ValueError, match="one \\[n, F\\] shape"):
+            eng.submit(np.zeros((2, 3), np.int32), np.zeros((2, 4), np.float32))
+
+    def test_predict_error_fails_only_that_flush(self):
+        calls = {"n": 0}
+
+        def flaky(ids, vals):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("device fell over")
+            return first_col_predict(ids, vals)
+
+        eng = ServingEngine(flaky, max_batch=4, max_delay_ms=5)
+        try:
+            with pytest.raises(RuntimeError, match="fell over"):
+                eng.predict(*_rows(2), timeout=10)
+            assert eng.stats.requests_failed == 1
+            # The engine survives: the next request succeeds.
+            assert eng.predict(*_rows(2), timeout=10).shape == (2,)
+        finally:
+            eng.close()
+
+
+# ---------------------------------------------------------------------------
+# Demux + latency stamps
+# ---------------------------------------------------------------------------
+
+class TestDemux:
+    def test_batched_requests_demuxed_row_for_row(self):
+        eng = ServingEngine(first_col_predict, max_batch=16,
+                            max_delay_ms=10_000, start=False)
+        sizes = (1, 5, 2, 8)
+        reqs = [(n, *_rows(n, base=17 * i)) for i, n in enumerate(sizes)]
+        futs = [eng.submit(ids, vals) for _, ids, vals in reqs]
+        eng.start()
+        eng.close(timeout=10)
+        for fut, (n, ids, vals) in zip(futs, reqs):
+            probs = fut.result(timeout=0)
+            assert probs.shape == (n,)
+            np.testing.assert_array_equal(probs, first_col_predict(ids, vals))
+            assert fut.latency_ms is not None and fut.latency_ms >= 0
+
+    def test_flushes_are_bucketed(self):
+        eng = ServingEngine(first_col_predict, max_batch=8, max_delay_ms=5,
+                            buckets=(2, 8))
+        try:
+            eng.predict(*_rows(1), timeout=10)   # 1 row -> bucket 2
+            assert eng.stats.padded_rows == 2 and eng.stats.real_rows == 1
+            summary = eng.stats.summary()
+            assert summary["batch_occupancy_pct"] == 50.0
+        finally:
+            eng.close()
+
+
+# ---------------------------------------------------------------------------
+# Hot swap under load + the torn-artifact regression (satellite 2)
+# ---------------------------------------------------------------------------
+
+def _fake_artifact(publish_dir, version):
+    os.makedirs(os.path.join(publish_dir, version))
+    export_lib.write_latest(publish_dir, version)
+
+
+class TestHotSwap:
+    def test_swap_under_load_zero_failures(self, tmp_path):
+        """Requests keep succeeding across a hot swap; after the swap they
+        see the new model; nothing is dropped or failed."""
+        pub = str(tmp_path)
+
+        def loader(path):
+            v = float(os.path.basename(path))
+            return lambda ids, vals: np.full((ids.shape[0],), v, np.float32)
+
+        _fake_artifact(pub, "1")
+        watcher = export_lib.watch_latest(pub, loader=loader, start=False)
+        eng = ServingEngine(watcher, max_batch=8, max_delay_ms=2)
+        try:
+            stop = threading.Event()
+            results, errors = [], []
+
+            def client():
+                while not stop.is_set():
+                    try:
+                        results.append(float(eng.predict(*_rows(2),
+                                                         timeout=10)[0]))
+                    except Exception as e:  # noqa: BLE001 - the assertion
+                        errors.append(e)
+
+            t = threading.Thread(target=client)
+            t.start()
+            try:
+                while len(results) < 5:          # traffic on model 1
+                    time.sleep(0.005)
+                _fake_artifact(pub, "2")
+                assert watcher.check_once()      # the hot swap, under load
+                seen = len(results)
+                while len(results) < seen + 5:   # traffic on model 2
+                    time.sleep(0.005)
+            finally:
+                stop.set()
+                t.join(timeout=10)
+            assert not errors
+            assert eng.stats.requests_failed == 0
+            assert results[0] == 1.0 and results[-1] == 2.0
+            assert watcher.swap_count == 2
+        finally:
+            eng.close()
+            watcher.close()
+
+    def test_torn_artifact_mid_poll_keeps_current_model(self, tmp_path):
+        """LATEST moves to a marker-less (torn) artifact while requests are
+        in flight: the load fails, ``swap_failures`` counts it, the current
+        model keeps serving, and the completed artifact swaps in later."""
+        pub = str(tmp_path)
+
+        def loader(path):
+            # Real load_serving semantics: no completion marker -> typed
+            # failure. (The fake keeps the test jax-free.)
+            if not os.path.exists(os.path.join(path, export_lib.COMPLETE_MARKER)):
+                raise export_lib.ArtifactIncomplete(path)
+            v = float(os.path.basename(path))
+            return lambda ids, vals: np.full((ids.shape[0],), v, np.float32)
+
+        os.makedirs(os.path.join(pub, "1"))
+        open(os.path.join(pub, "1", export_lib.COMPLETE_MARKER), "w").close()
+        export_lib.write_latest(pub, "1")
+        watcher = export_lib.watch_latest(pub, loader=loader, start=False)
+        assert watcher.swap_failures == 0
+        eng = ServingEngine(watcher, max_batch=8, max_delay_ms=2)
+        try:
+            assert eng.predict(*_rows(2), timeout=10)[0] == 1.0
+            # A publisher crashes mid-write: dir + pointer, no marker.
+            os.makedirs(os.path.join(pub, "2"))
+            export_lib.write_latest(pub, "2")
+            assert not watcher.check_once()
+            assert watcher.swap_failures == 1
+            assert watcher.swap_count == 1
+            # In-flight traffic still lands on model 1.
+            assert eng.predict(*_rows(2), timeout=10)[0] == 1.0
+            # The export completes; the next poll swaps.
+            open(os.path.join(pub, "2", export_lib.COMPLETE_MARKER),
+                 "w").close()
+            assert watcher.check_once()
+            assert watcher.swap_failures == 1
+            assert eng.predict(*_rows(2), timeout=10)[0] == 2.0
+            assert eng.stats.requests_failed == 0
+        finally:
+            eng.close()
+            watcher.close()
+
+    def test_swap_blackout_recorded(self):
+        clock = [0.0]
+        stats = ServingStats(clock=lambda: clock[0])
+        stats.record_flush(4, 4)
+        stats.record_swap()
+        clock[0] = 0.25
+        stats.record_flush(4, 4)
+        assert stats.summary()["swap_blackout_ms"] == 250.0
+
+
+# ---------------------------------------------------------------------------
+# Config plumbing (satellite 4's flag surface)
+# ---------------------------------------------------------------------------
+
+class TestConfig:
+    def test_from_config(self):
+        cfg = Config(serve_max_batch=16, serve_max_delay_ms=3.0,
+                     serve_buckets="4,16")
+        eng = ServingEngine.from_config(cfg, first_col_predict, start=False)
+        assert eng.max_batch == 16
+        assert eng.max_delay_s == pytest.approx(0.003)
+        assert eng.buckets == (4, 16)
+        assert eng.queue_rows == 8 * 16
+
+    def test_default_buckets_are_pow2_ladder(self):
+        eng = ServingEngine.from_config(Config(serve_max_batch=12),
+                                        first_col_predict, start=False)
+        assert eng.buckets == (1, 2, 4, 8, 12)
+
+    def test_bad_flags_rejected(self):
+        with pytest.raises(ValueError, match="serve_buckets"):
+            Config(serve_buckets="64", serve_max_batch=32)
+        with pytest.raises(ValueError, match="serve_queue_rows"):
+            Config(serve_queue_rows=8, serve_max_batch=32)
+        with pytest.raises(ValueError, match="serve_max_delay_ms"):
+            Config(serve_max_delay_ms=-1)
+
+
+# ---------------------------------------------------------------------------
+# End-to-end smoke over a REAL artifact (satellite 5's fast half)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def real_artifact(tmp_path_factory):
+    from deepfm_tpu.train import Trainer
+    cfg = Config(
+        feature_size=120, field_size=FIELD_SIZE, embedding_size=4,
+        deep_layers="8", dropout="1.0", batch_size=16,
+        compute_dtype="float32", mesh_data=1, log_steps=0, seed=3)
+    trainer = Trainer(cfg)
+    state = trainer.init_state()
+    out = str(tmp_path_factory.mktemp("serve") / "1")
+    orig = export_lib._export_tf_savedmodel
+    export_lib._export_tf_savedmodel = lambda *a, **k: None  # ~10s saved
+    try:
+        export_lib.export_serving(trainer.model, state, cfg, out)
+    finally:
+        export_lib._export_tf_savedmodel = orig
+    return out
+
+
+class TestRealArtifact:
+    def test_bucketed_output_equals_unpadded(self, real_artifact):
+        """The parity the whole shape policy rests on: padded-bucket probs
+        are bit-equal to the unpadded call, row for row."""
+        raw = export_lib.load_serving(real_artifact)
+        bucketed = export_lib.load_serving(real_artifact, buckets=(2, 4, 16))
+        rng = np.random.default_rng(0)
+        for n in (1, 2, 3, 7, 16):
+            ids = rng.integers(0, 120, (n, FIELD_SIZE)).astype(np.int32)
+            vals = rng.normal(size=(n, FIELD_SIZE)).astype(np.float32)
+            np.testing.assert_array_equal(bucketed(ids, vals),
+                                          raw(ids, vals))
+        assert bucketed.calls_per_bucket[16] == 2  # n=7 and n=16
+
+    def test_engine_serves_real_model(self, real_artifact):
+        fn = export_lib.load_serving(real_artifact)
+        eng = ServingEngine(fn, max_batch=16, max_delay_ms=5)
+        try:
+            rng = np.random.default_rng(1)
+            futs = []
+            for n in (1, 4, 9):
+                ids = rng.integers(0, 120, (n, FIELD_SIZE)).astype(np.int32)
+                vals = rng.normal(size=(n, FIELD_SIZE)).astype(np.float32)
+                futs.append((eng.submit(ids, vals), ids, vals))
+            for fut, ids, vals in futs:
+                probs = fut.result(timeout=30)
+                assert probs.shape == (ids.shape[0],)
+                assert np.all(np.isfinite(probs))
+                assert np.all((probs >= 0) & (probs <= 1))
+                np.testing.assert_array_equal(probs, np.asarray(fn(ids, vals)))
+            summary = eng.stats.summary()
+            assert summary["serving_requests"] == 3
+            assert summary["serving_failed"] == 0
+            assert summary["batch_occupancy_pct"] > 0
+        finally:
+            eng.close()
+
+
+# ---------------------------------------------------------------------------
+# The full acceptance drill (satellite 5's slow half)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_serving_drill_end_to_end(tmp_path):
+    """Live publisher + concurrent engine: >= 2 hot swaps under client
+    load, zero dropped/failed requests, bucket parity bit-equal, report
+    fields populated. Excluded from tier-1; also runs standalone via
+    ``scripts/serving_drill.py`` (which writes SERVING_r0N.json)."""
+    import serving_drill
+    report = serving_drill.run_drill(
+        str(tmp_path), report_path=str(tmp_path / "SERVING.json"),
+        verbose=False)
+    assert report["ok"]
+    assert report["hot_swaps"] >= 3          # initial load + >= 2 hot swaps
+    assert report["serving_failed"] == 0 and report["swap_failures"] == 0
+    assert report["batch_occupancy_pct"] > 0
+    assert report["serving_p99_ms"] is not None
