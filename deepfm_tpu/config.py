@@ -192,6 +192,32 @@ class Config:
     # default) or allgather_table (traffic ∝ table; huge-batch/small-table
     # regimes). See TUNING.md "Sharded embedding lookup".
     embedding_lookup: str = "masked_psum"
+    # ---- embedding scale (README "Embedding scale", TUNING §2.11) ----
+    # Gradient application to the embedding tables: "dense" (the bit-exact
+    # reference — full-table optimizer sweep every step) or "sparse" (dedup
+    # the batch's ids, segment-sum cotangents, lazy timestamped Adam on the
+    # touched rows only — step cost ∝ unique ids, not vocab). sparse
+    # requires Adam and a single-device (1x1) mesh; L2 decays touched rows
+    # only (documented deviation, tolerance-pinned against dense).
+    embedding_update: str = "dense"   # dense | sparse
+    # Hash-bucketed multi-table embeddings: comma list of per-table bucket
+    # counts ("" = one monolithic feature_size table). N tables replace the
+    # monolithic table; ids map to (table, bucket) by deterministic uint32
+    # mixing, so feature_size may exceed any single allocation.
+    embedding_buckets: str = ""
+    # How ids pick their table in hashed mode: "hash" (id-mixed, balanced)
+    # or "field" (field index mod N — per-field tables).
+    embedding_assign: str = "hash"
+    # Hot/cold tiered storage: "hot_cold" keeps an HBM-resident hot-row
+    # cache (embedding_hot_rows slots) over a host-RAM cold store, with the
+    # cold fetch for dispatch t+1 prefetched on the staging thread while
+    # dispatch t computes. Requires embedding_update=sparse, the monolithic
+    # table layout, and a single-device mesh.
+    embedding_tiering: str = "off"    # off | hot_cold
+    embedding_hot_rows: int = 0       # hot-cache capacity in rows (tiering)
+    # Cold-store precision: float32, or int8 with a per-row dequant scale
+    # (halves→quarters host bytes; fetch dequantizes, writeback requantizes).
+    embedding_cold_dtype: str = "float32"  # float32 | int8
 
     # ---- checkpoint / export / logging ----
     model_dir: str = ""               # checkpoint dir (shared storage; reference :434)
@@ -299,6 +325,80 @@ class Config:
             raise ValueError(
                 f"serve_buckets {self.serve_buckets!r} exceeds "
                 f"serve_max_batch={self.serve_max_batch}")
+        if self.embedding_update not in ("dense", "sparse"):
+            raise ValueError(
+                f"embedding_update must be dense|sparse, got "
+                f"{self.embedding_update!r}")
+        if self.embedding_update == "sparse":
+            if self.optimizer.lower() != "adam":
+                raise ValueError(
+                    "embedding_update=sparse implements the lazy/timestamped "
+                    "row update for Adam only; use --optimizer Adam or "
+                    "--embedding_update dense")
+            if self.mesh_model > 1:
+                raise ValueError(
+                    "embedding_update=sparse does not compose with row-"
+                    "sharded tables (mesh_model>1): per-shard touch sets "
+                    "would diverge the replicas; use --embedding_update "
+                    "dense")
+        try:
+            buckets = self.embedding_bucket_sizes
+        except ValueError as exc:
+            raise ValueError(
+                f"embedding_buckets must be a comma list of positive ints, "
+                f"got {self.embedding_buckets!r}") from exc
+        if any(b < 1 for b in buckets):
+            raise ValueError(
+                f"embedding_buckets must be positive ints, got "
+                f"{self.embedding_buckets!r}")
+        if buckets and self.mesh_model > 1:
+            raise ValueError(
+                "hash-bucketed multi-table embeddings (embedding_buckets) "
+                "do not row-shard yet; mesh_model must be 1")
+        if self.embedding_assign not in ("hash", "field"):
+            raise ValueError(
+                f"embedding_assign must be hash|field, got "
+                f"{self.embedding_assign!r}")
+        if self.embedding_tiering not in ("off", "hot_cold"):
+            raise ValueError(
+                f"embedding_tiering must be off|hot_cold, got "
+                f"{self.embedding_tiering!r}")
+        if self.embedding_cold_dtype not in ("float32", "int8"):
+            raise ValueError(
+                f"embedding_cold_dtype must be float32|int8, got "
+                f"{self.embedding_cold_dtype!r}")
+        if self.embedding_tiering == "hot_cold":
+            if self.embedding_update != "sparse":
+                raise ValueError(
+                    "embedding_tiering=hot_cold requires "
+                    "embedding_update=sparse (the hot cache only holds rows "
+                    "the sparse update touches)")
+            if buckets:
+                raise ValueError(
+                    "embedding_tiering=hot_cold supports the monolithic "
+                    "table layout only (unset embedding_buckets)")
+            if self.embedding_hot_rows < 1:
+                raise ValueError(
+                    "embedding_tiering=hot_cold needs embedding_hot_rows "
+                    ">= 1 (hot-cache capacity)")
+            if self.embedding_hot_rows >= self.feature_size:
+                raise ValueError(
+                    "embedding_hot_rows >= feature_size: the whole table "
+                    "fits in HBM — turn tiering off")
+            if self.device_dataset:
+                raise ValueError(
+                    "embedding_tiering=hot_cold and device_dataset are "
+                    "mutually exclusive (tiering owns the staged feed)")
+            if self.on_nonfinite == "rollback":
+                raise ValueError(
+                    "embedding_tiering=hot_cold does not support "
+                    "on_nonfinite=rollback (checkpoints capture only the "
+                    "hot tier); use abort or skip")
+            if self.online_mode:
+                raise ValueError(
+                    "embedding_tiering=hot_cold does not support "
+                    "online_mode yet (published artifacts would hold only "
+                    "the hot tier)")
         if self.decoded_cache not in ("off", "ram", "disk"):
             raise ValueError(
                 f"decoded_cache must be off|ram|disk, got "
@@ -323,6 +423,10 @@ class Config:
     @property
     def serve_bucket_sizes(self) -> List[int]:
         return [int(x) for x in self.serve_buckets.split(",") if x.strip()]
+
+    @property
+    def embedding_bucket_sizes(self) -> List[int]:
+        return [int(x) for x in self.embedding_buckets.split(",") if x.strip()]
 
     @property
     def channel_names(self) -> List[str]:

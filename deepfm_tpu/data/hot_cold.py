@@ -1,0 +1,475 @@
+"""Hot/cold tiered embedding storage: HBM-resident hot-row cache over a
+host-RAM cold store, with prefetch keyed off the staged batch lookahead.
+
+The embedding tables (and their lazy-Adam m/v/tau slots) live on the host;
+only a ``--embedding_hot_rows``-row hot cache is device-resident. The fit
+loop's staging thread already sees batch groups ``transfer_ahead`` dispatches
+early, so the runtime plans each group there: look up which ids are already
+hot, pick LRU victims for the misses, FETCH the missing rows from the cold
+store (this is the overlap — the host memcpy/dequant for dispatch t+1 runs
+while the device computes dispatch t), and remap the group's ``feat_ids``
+from global ids to hot SLOT ids. The main loop then applies the queued plan
+(evicted-row write-back + fetched-row install) right before its dispatch.
+
+Correctness hinges on three orderings, all enforced here:
+
+* Plans are FIFO: ``apply_next`` consumes them in the exact order
+  ``plan_group`` queued them, which is the dispatch order.
+* A row evicted by a still-pending plan cannot be re-fetched from the cold
+  store early (its write-back hasn't happened) — those rows are marked
+  late-fetch and read at apply time, after the pending write-back.
+* Slots referenced by any not-yet-applied plan are pinned (refcounted) and
+  never chosen as victims; if a group's working set cannot fit in the
+  unpinned slots the runtime raises instead of silently corrupting.
+
+The device step programs are unchanged: staged ``feat_ids`` are slot ids,
+the sparse-update plan's OOB fill (``padded_vocab`` > hot_rows) still drops
+in the hot-table scatter, and JAX's immutable arrays make installs for
+dispatch t+1 invisible to the already-enqueued dispatch t.
+
+Optional int8 cold storage (``--embedding_cold_dtype int8``) halves the
+host bytes of the weight tables with a scale-per-row dequant on fetch /
+requant on write-back; the m/v moment slots stay float32 (quantizing the
+second moment distorts the Adam denominator far more than the weights).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..utils import faults
+from ..utils import logging as ulog
+
+
+def _pow2_pad(n: int) -> int:
+    """Smallest power of two >= n (>= 1): bounds the number of compiled
+    install/evict program shapes to O(log max_group)."""
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+class ColdStore:
+    """Host-RAM row store for ONE table: float32, or int8 with a per-row
+    float32 scale (row-max/127 symmetric quant, dequant on fetch)."""
+
+    def __init__(self, array: np.ndarray, dtype: str):
+        a = np.asarray(array, np.float32)
+        self.shape = a.shape
+        self.dtype = dtype
+        self._trail = tuple(range(1, a.ndim))
+        if dtype == "int8":
+            self._scale = np.empty(a.shape[:1], np.float32)
+            self._q = np.empty(a.shape, np.int8)
+            self.write(np.arange(a.shape[0]), a)
+        elif dtype == "float32":
+            self._data = a.copy()
+        else:
+            raise ValueError(f"unknown cold dtype {dtype!r}")
+
+    def nbytes(self) -> int:
+        if self.dtype == "int8":
+            return self._q.nbytes + self._scale.nbytes
+        return self._data.nbytes
+
+    def fetch(self, ids: np.ndarray) -> np.ndarray:
+        """float32 rows at ``ids`` (dequantized for int8). The fault seam
+        fires here — callers retry via :meth:`TieredEmbeddingRuntime`."""
+        faults.check_cold_fetch()
+        ids = np.asarray(ids, np.int64)
+        if self.dtype == "int8":
+            scale = self._scale[ids].reshape(
+                (-1,) + (1,) * len(self._trail))
+            return self._q[ids].astype(np.float32) * scale
+        return self._data[ids].copy()
+
+    def write(self, ids: np.ndarray, rows: np.ndarray) -> None:
+        ids = np.asarray(ids, np.int64)
+        rows = np.asarray(rows, np.float32)
+        if self.dtype == "int8":
+            amax = np.abs(rows).max(axis=self._trail) if self._trail \
+                else np.abs(rows)
+            scale = np.maximum(amax, 1e-12).astype(np.float32) / 127.0
+            self._scale[ids] = scale
+            q = np.rint(rows / scale.reshape((-1,) + (1,) * len(self._trail)))
+            self._q[ids] = np.clip(q, -127, 127).astype(np.int8)
+        else:
+            self._data[ids] = rows
+
+    def dense(self) -> np.ndarray:
+        """The whole table as float32 (eval/export densification)."""
+        if self.dtype == "int8":
+            return self._q.astype(np.float32) * self._scale.reshape(
+                (-1,) + (1,) * len(self._trail))
+        return self._data.copy()
+
+
+class _InstallPlan:
+    """One dispatch group's queued cache transaction (built on the staging
+    thread, applied on the main thread in FIFO order)."""
+
+    __slots__ = ("evict_slots", "evict_ids", "install_slots", "install_ids",
+                 "late_idx", "values", "group_slots")
+
+    def __init__(self):
+        self.evict_slots: np.ndarray = np.zeros((0,), np.int32)
+        self.evict_ids: np.ndarray = np.zeros((0,), np.int32)
+        self.install_slots: np.ndarray = np.zeros((0,), np.int32)
+        self.install_ids: np.ndarray = np.zeros((0,), np.int32)
+        self.late_idx: np.ndarray = np.zeros((0,), np.int64)
+        # name -> {"w","m","v","tau"} arrays [I, ...] (late rows filled at
+        # apply time, after the pending eviction's write-back).
+        self.values: Dict[str, Dict[str, np.ndarray]] = {}
+        self.group_slots: np.ndarray = np.zeros((0,), np.int32)
+
+
+class TieredEmbeddingRuntime:
+    """Owns the id->slot directory, the per-param cold stores, and the
+    plan/apply protocol described in the module docstring."""
+
+    def __init__(self, cfg: Config, model: Any):
+        if cfg.embedding_bucket_sizes:
+            raise ValueError("hot/cold tiering supports the monolithic "
+                             "table layout only")
+        self.cfg = cfg
+        self.model = model
+        self.names: Tuple[str, ...] = tuple(model.embedding_param_names())
+        self.hot_rows = int(cfg.embedding_hot_rows)
+        self.feature_size = int(cfg.feature_size)
+        # Directory (staging thread owns mutations after adopt()).
+        self.id_to_slot = np.full((self.feature_size,), -1, np.int32)
+        self.slot_to_id = np.full((self.hot_rows,), -1, np.int32)
+        self.last_used = np.zeros((self.hot_rows,), np.int64)
+        self.pin_count = np.zeros((self.hot_rows,), np.int32)
+        self.clock = 0
+        self._free: List[int] = list(range(self.hot_rows - 1, -1, -1))
+        self._pending: "collections.deque[_InstallPlan]" = collections.deque()
+        self._pending_evicted: Dict[int, int] = {}  # id -> pending count
+        self._lock = threading.Lock()
+        # Signaled by apply_next when it releases a plan's slot pins; the
+        # staging thread waits on it when the lookahead has pinned too much
+        # of the cache for the next group to fit.
+        self._cond = threading.Condition(self._lock)
+        self.cold: Dict[str, ColdStore] = {}
+        self.cold_m: Dict[str, np.ndarray] = {}
+        self.cold_v: Dict[str, np.ndarray] = {}
+        self.cold_tau: Dict[str, np.ndarray] = {}
+        self.stats: Dict[str, float] = {
+            "lookups": 0, "hits": 0, "misses": 0, "evictions": 0,
+            "installs": 0, "plans": 0, "fetch_retries": 0,
+            "prefetch_fetch_s": 0.0,   # cold fetches on the staging thread
+            "apply_fetch_s": 0.0,      # late fetches on the main thread
+            "apply_s": 0.0,            # total main-thread apply time
+        }
+        self._adopted = False
+
+    # -- state adoption -------------------------------------------------
+    def adopt(self, state):
+        """Move the full tables (and their lazy-Adam slots) to the cold
+        store and shrink the device-resident state to ``hot_rows`` rows.
+        Called once by ``Trainer.init_state``."""
+        if self._adopted:
+            raise RuntimeError("TieredEmbeddingRuntime.adopt called twice")
+        params = dict(state.params)
+        opt = dict(state.opt_state)
+        embed = dict(opt["embed"])
+        for name in self.names:
+            full = np.asarray(jax.device_get(params[name]), np.float32)
+            real = full[: self.feature_size]  # pad rows are zero; drop them
+            self.cold[name] = ColdStore(real, self.cfg.embedding_cold_dtype)
+            self.cold_m[name] = np.zeros(real.shape, np.float32)
+            self.cold_v[name] = np.zeros(real.shape, np.float32)
+            self.cold_tau[name] = np.zeros((self.feature_size,), np.int32)
+            hot_shape = (self.hot_rows,) + real.shape[1:]
+            params[name] = jnp.zeros(hot_shape, jnp.float32)
+            from ..train import optimizers as opt_lib  # noqa: PLC0415
+            embed[name] = {"table": opt_lib.EmbedAdamEntry(
+                m=jnp.zeros(hot_shape, jnp.float32),
+                v=jnp.zeros(hot_shape, jnp.float32),
+                tau=jnp.zeros((self.hot_rows,), jnp.int32))}
+            ulog.info(
+                f"hot/cold: {name} cold={self.cold[name].nbytes() / 2**20:.1f}"
+                f" MiB host ({self.cfg.embedding_cold_dtype}), hot="
+                f"{self.hot_rows} rows device-resident")
+        opt["embed"] = embed
+        self._adopted = True
+        return state.replace(params=params, opt_state=opt)
+
+    # -- staging-thread side --------------------------------------------
+    def _fetch(self, store: ColdStore, ids: np.ndarray) -> np.ndarray:
+        """Cold fetch with bounded retry healing of injected/transient
+        faults (the cold store is host RAM here, but the seam models a
+        remote parameter tier where fetches can transiently fail)."""
+        attempts = 3
+        for i in range(attempts):
+            try:
+                return store.fetch(ids)
+            except faults.InjectedFault as exc:
+                if i == attempts - 1:
+                    raise
+                self.stats["fetch_retries"] += 1
+                ulog.warning(f"cold fetch failed ({exc}); retrying")
+
+    def plan_group(self, group: List[Dict[str, np.ndarray]]
+                   ) -> List[Dict[str, np.ndarray]]:
+        """Plan one dispatch group's cache transaction and remap its
+        ``feat_ids`` to hot slot ids. Runs on the staging thread; the cold
+        fetches issued here are the prefetch that overlaps device compute."""
+        with self._lock:
+            return self._plan_group_locked(group)
+
+    def _plan_group_locked(self, group):
+        self.clock += 1
+        self.stats["plans"] += 1
+        flat = np.concatenate([b["feat_ids"].ravel() for b in group])
+        uids = np.unique(flat.astype(np.int64))
+        if uids.size and (uids[0] < 0 or uids[-1] >= self.feature_size):
+            raise ValueError("feat_ids outside [0, feature_size) under "
+                             "hot/cold tiering")
+        self.stats["lookups"] += int(uids.size)
+        plan = _InstallPlan()
+        slots = self.id_to_slot[uids]
+        resident = slots >= 0
+        self.stats["hits"] += int(resident.sum())
+        missing = uids[~resident]
+        self.stats["misses"] += int(missing.size)
+        # Pin + refresh everything this group touches BEFORE victim
+        # selection so the group can never evict its own working set.
+        self.last_used[slots[resident]] = self.clock
+        if missing.size:
+            evict_slots: List[int] = []
+            evict_ids: List[int] = []
+            new_slots = np.empty((missing.size,), np.int32)
+
+            def evictable():
+                # Unpinned resident slots, excluding the rows this very
+                # group just refreshed. Only this (staging) thread mutates
+                # residency/last_used; apply_next only releases pins.
+                cand = np.flatnonzero(
+                    (self.pin_count == 0) & (self.slot_to_id >= 0))
+                return cand[self.last_used[cand] < self.clock]
+
+            # The prefetch lookahead pins every pending group's working
+            # set; if the next group doesn't fit in what's left, wait for
+            # the main thread to apply a plan and release its pins (the
+            # staging thread simply stops running ahead). Only when no
+            # pins are outstanding is the cache GENUINELY too small.
+            while len(self._free) + evictable().size < missing.size:
+                # Pins outstanding (even if the plan was already popped and
+                # is mid-apply) mean the main thread will free slots; only
+                # a pin-free shortfall is a genuine capacity error.
+                if not self._pending and int(self.pin_count.sum()) == 0:
+                    raise RuntimeError(
+                        f"hot cache too small: group needs {missing.size} "
+                        f"installs but only {len(self._free)} free + "
+                        f"{evictable().size} evictable slots "
+                        f"(embedding_hot_rows={self.hot_rows}; raise it "
+                        f"above one dispatch group's unique-id working set)")
+                if not self._cond.wait(timeout=120.0):
+                    raise RuntimeError(
+                        "hot/cold tiering stalled waiting for slot pins to "
+                        "release (main loop not applying plans?)")
+            n_free = min(len(self._free), missing.size)
+            for i in range(n_free):
+                new_slots[i] = self._free.pop()
+            need = missing.size - n_free
+            if need > 0:
+                cand = evictable()
+                victims = cand[np.argsort(
+                    self.last_used[cand], kind="stable")][:need]
+                for j, s in enumerate(victims):
+                    vid = int(self.slot_to_id[s])
+                    evict_slots.append(int(s))
+                    evict_ids.append(vid)
+                    self.id_to_slot[vid] = -1
+                    self._pending_evicted[vid] = \
+                        self._pending_evicted.get(vid, 0) + 1
+                    new_slots[n_free + j] = s
+            self.stats["evictions"] += len(evict_ids)
+            self.stats["installs"] += int(missing.size)
+            self.id_to_slot[missing] = new_slots
+            self.slot_to_id[new_slots] = missing
+            self.last_used[new_slots] = self.clock
+            plan.evict_slots = np.asarray(evict_slots, np.int32)
+            plan.evict_ids = np.asarray(evict_ids, np.int32)
+            plan.install_slots = new_slots
+            plan.install_ids = missing.astype(np.int32)
+            # Rows whose write-back is still pending must be fetched at
+            # apply time (their cold copy is stale until then). Evicted and
+            # installed ids are disjoint within one plan (resident vs not),
+            # so any pending entry here is from an OLDER plan.
+            late = np.asarray(
+                [i for i, mid in enumerate(missing)
+                 if self._pending_evicted.get(int(mid), 0) > 0], np.int64)
+            plan.late_idx = late
+            early = np.setdiff1d(np.arange(missing.size), late)
+            t0 = time.time()
+            for name in self.names:
+                vals = {
+                    "w": np.zeros((missing.size,)
+                                  + self.cold[name].shape[1:], np.float32),
+                    "m": np.zeros((missing.size,)
+                                  + self.cold[name].shape[1:], np.float32),
+                    "v": np.zeros((missing.size,)
+                                  + self.cold[name].shape[1:], np.float32),
+                    "tau": np.zeros((missing.size,), np.int32),
+                }
+                if early.size:
+                    eids = missing[early]
+                    vals["w"][early] = self._fetch(self.cold[name], eids)
+                    vals["m"][early] = self.cold_m[name][eids]
+                    vals["v"][early] = self.cold_v[name][eids]
+                    vals["tau"][early] = self.cold_tau[name][eids]
+                plan.values[name] = vals
+            self.stats["prefetch_fetch_s"] += time.time() - t0
+        # Pin every slot the group references until its plan is applied.
+        group_slots = self.id_to_slot[uids]
+        self.pin_count[group_slots] += 1
+        plan.group_slots = group_slots.astype(np.int32)
+        self._pending.append(plan)
+        # Remap the group's ids to slot ids (the arrays staged to device).
+        out = []
+        for b in group:
+            nb = dict(b)
+            nb["feat_ids"] = self.id_to_slot[
+                b["feat_ids"].astype(np.int64)].astype(np.int32)
+            out.append(nb)
+        return out
+
+    # -- main-thread side -----------------------------------------------
+    def _install(self, table: jax.Array, slots: np.ndarray,
+                 vals: np.ndarray) -> jax.Array:
+        """Padded scatter-install: slots/vals padded to the next power of
+        two with the OOB slot id ``hot_rows`` (dropped by the scatter), so
+        compile count stays O(log max_group) per table shape."""
+        p = _pow2_pad(max(slots.size, 1))
+        ps = np.full((p,), self.hot_rows, np.int32)
+        ps[: slots.size] = slots
+        pv = np.zeros((p,) + vals.shape[1:], vals.dtype)
+        pv[: slots.size] = vals
+        return _jit_install(table, ps, pv)
+
+    def apply_next(self, state):
+        """Apply the oldest queued plan to ``state``: write evicted rows
+        back to the cold store (reading the post-previous-dispatch values —
+        device_get blocks on the producing program), late-fetch any rows
+        whose cold copy only just became current, then install the fetched
+        rows (weights + m/v/tau) into their hot slots."""
+        if not self._pending:
+            return state
+        t_apply = time.time()
+        plan = self._pending.popleft()
+        params = dict(state.params)
+        opt = dict(state.opt_state)
+        embed = dict(opt["embed"])
+        if plan.evict_slots.size:
+            es = plan.evict_slots
+            for name in self.names:
+                oe = embed[name]["table"]
+                self.cold[name].write(
+                    plan.evict_ids,
+                    np.asarray(jax.device_get(params[name][es]), np.float32))
+                self.cold_m[name][plan.evict_ids] = np.asarray(
+                    jax.device_get(oe.m[es]), np.float32)
+                self.cold_v[name][plan.evict_ids] = np.asarray(
+                    jax.device_get(oe.v[es]), np.float32)
+                self.cold_tau[name][plan.evict_ids] = np.asarray(
+                    jax.device_get(oe.tau[es]), np.int32)
+            with self._lock:
+                for vid in plan.evict_ids:
+                    vid = int(vid)
+                    left = self._pending_evicted.get(vid, 0) - 1
+                    if left <= 0:
+                        self._pending_evicted.pop(vid, None)
+                    else:
+                        self._pending_evicted[vid] = left
+        if plan.late_idx.size:
+            t0 = time.time()
+            lids = plan.install_ids[plan.late_idx].astype(np.int64)
+            for name in self.names:
+                vals = plan.values[name]
+                vals["w"][plan.late_idx] = self._fetch(self.cold[name], lids)
+                vals["m"][plan.late_idx] = self.cold_m[name][lids]
+                vals["v"][plan.late_idx] = self.cold_v[name][lids]
+                vals["tau"][plan.late_idx] = self.cold_tau[name][lids]
+            self.stats["apply_fetch_s"] += time.time() - t0
+        if plan.install_slots.size:
+            s = plan.install_slots
+            from ..train import optimizers as opt_lib  # noqa: PLC0415
+            for name in self.names:
+                vals = plan.values[name]
+                oe = embed[name]["table"]
+                params[name] = self._install(params[name], s, vals["w"])
+                embed[name] = {"table": opt_lib.EmbedAdamEntry(
+                    m=self._install(oe.m, s, vals["m"]),
+                    v=self._install(oe.v, s, vals["v"]),
+                    tau=self._install(oe.tau, s, vals["tau"]))}
+        with self._cond:
+            self.pin_count[plan.group_slots] -= 1
+            self._cond.notify_all()
+        opt["embed"] = embed
+        self.stats["apply_s"] += time.time() - t_apply
+        return state.replace(params=params, opt_state=opt)
+
+    # -- eval / export --------------------------------------------------
+    def flush(self, state) -> None:
+        """Write every resident hot row (weights + moments) back to the
+        cold store. Leaves residency unchanged (the hot copy stays the
+        authoritative one for training)."""
+        with self._lock:
+            res = np.flatnonzero(self.slot_to_id >= 0)
+            ids = self.slot_to_id[res].astype(np.int64)
+        if not res.size:
+            return
+        embed = state.opt_state["embed"]
+        for name in self.names:
+            self.cold[name].write(ids, np.asarray(
+                jax.device_get(state.params[name][res]), np.float32))
+            oe = embed[name]["table"]
+            self.cold_m[name][ids] = np.asarray(
+                jax.device_get(oe.m[res]), np.float32)
+            self.cold_v[name][ids] = np.asarray(
+                jax.device_get(oe.v[res]), np.float32)
+            self.cold_tau[name][ids] = np.asarray(
+                jax.device_get(oe.tau[res]), np.int32)
+
+    def densified(self, state):
+        """A state whose embedding params are the FULL ``[padded_vocab,...]``
+        float32 tables (flushed hot rows + cold rows + zero pad rows) — the
+        offline eval/predict path runs the ordinary dense forward on it."""
+        self.flush(state)
+        params = dict(state.params)
+        pv = self.model.emb.padded_vocab
+        for name in self.names:
+            real = self.cold[name].dense()
+            full = np.zeros((pv,) + real.shape[1:], np.float32)
+            full[: self.feature_size] = real
+            params[name] = jnp.asarray(full)
+        return state.replace(params=params)
+
+    def hit_rate(self) -> float:
+        n = self.stats["lookups"]
+        return float(self.stats["hits"] / n) if n else 0.0
+
+    def overlap_fraction(self) -> float:
+        """Fraction of total cold-fetch wall time that ran on the staging
+        thread (i.e. overlapped device compute instead of stalling the
+        dispatch loop)."""
+        tot = self.stats["prefetch_fetch_s"] + self.stats["apply_fetch_s"]
+        return float(self.stats["prefetch_fetch_s"] / tot) if tot else 1.0
+
+
+@jax.jit
+def _jit_install(table: jax.Array, slots: jax.Array,
+                 vals: jax.Array) -> jax.Array:
+    """table.at[slots].set(vals) with OOB-padded slots dropped."""
+    return table.at[slots].set(vals)

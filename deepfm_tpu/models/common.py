@@ -26,6 +26,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..ops import embedding as emb_ops
+
 Params = Dict[str, Any]
 State = Dict[str, Any]
 
@@ -192,3 +194,177 @@ def apply_tower(
 def l2_half_sum(x: jnp.ndarray) -> jnp.ndarray:
     """tf.nn.l2_loss semantics: 0.5 * sum(x^2) (reference loss ``:244-246``)."""
     return 0.5 * jnp.sum(jnp.square(x.astype(jnp.float32)))
+
+
+# ---------------------------------------------------------------------------
+# Embedding schema: monolithic vs hash-bucketed multi-table layout
+# ---------------------------------------------------------------------------
+
+
+class EmbeddingSchema:
+    """Resolves cfg into the embedding-table layout and owns every operation
+    the models and trainer perform on it.
+
+    Two layouts behind one interface:
+
+    * **monolithic** (``embedding_buckets`` empty): one ``[padded_vocab,...]``
+      array per embedding param — the original layout, entry pytree and init
+      numerics unchanged (checkpoints stay compatible).
+    * **hashed** (``embedding_buckets`` set): a dict of N tables
+      ``{"t0": [B0,...], ...}``; ids map to a table (by id-hash or by field)
+      and to a per-table bucket via stateless uint32 mixing
+      (ops.embedding.hash_bucket), so the *logical* ``feature_size`` can
+      exceed any single physical allocation.
+
+    The sparse-update path speaks :class:`ops.embedding.PlanEntry` per
+    table: the trainer builds one plan per batch, gathers the touched rows
+    as the gradient leaf, and the models consume the gathered view through
+    ``lookup_rows`` — the cotangent scatter-add (the segment-sum) therefore
+    sizes with the batch's unique ids, never with the vocab.
+    """
+
+    #: plan/rows dict key for the monolithic table
+    MONO = "table"
+
+    def __init__(self, cfg: Any):
+        self.feature_size = int(cfg.feature_size)
+        self.field_size = int(cfg.field_size)
+        self.buckets: List[int] = list(cfg.embedding_bucket_sizes)
+        self.hashed = bool(self.buckets)
+        self.assign = cfg.embedding_assign
+        self.lookup_strategy = cfg.embedding_lookup
+        self.padded_vocab = emb_ops.padded_vocab(
+            cfg.feature_size, cfg.mesh_model)
+
+    # -- layout ---------------------------------------------------------
+    def table_keys(self) -> List[str]:
+        if not self.hashed:
+            return [self.MONO]
+        return [f"t{i}" for i in range(len(self.buckets))]
+
+    def num_physical_rows(self) -> int:
+        """Rows actually allocated (vs the logical feature_size)."""
+        return sum(self.buckets) if self.hashed else self.padded_vocab
+
+    def init_entry(self, rng: jax.Array, trailing: Tuple[int, ...]) -> Any:
+        """Glorot-normal tables (reference embedding init). Monolithic
+        reproduces the original init bit-for-bit: glorot over the REAL
+        vocab, zero pad rows concatenated after."""
+        if not self.hashed:
+            t = glorot_normal(rng, (self.feature_size, *trailing))
+            if self.padded_vocab != self.feature_size:
+                pad = self.padded_vocab - self.feature_size
+                t = jnp.concatenate(
+                    [t, jnp.zeros((pad, *trailing), t.dtype)])
+            return t
+        keys = jax.random.split(rng, len(self.buckets))
+        return {f"t{i}": glorot_normal(keys[i], (b, *trailing))
+                for i, b in enumerate(self.buckets)}
+
+    # -- id -> (table, bucket) mapping ---------------------------------
+    def _table_of(self, feat_ids: jnp.ndarray) -> jnp.ndarray:
+        n = len(self.buckets)
+        if self.assign == "field":
+            f = jnp.arange(feat_ids.shape[-1], dtype=jnp.int32) % n
+            return jnp.broadcast_to(f, feat_ids.shape)
+        return emb_ops.hash_table_assign(feat_ids, n)
+
+    # -- dense forward --------------------------------------------------
+    def lookup(self, entry: Any, feat_ids: jnp.ndarray, *,
+               axis_name: Optional[str] = None) -> jnp.ndarray:
+        """[B,F,*trailing] gather for the dense path (and eval/predict)."""
+        if not self.hashed:
+            return emb_ops.lookup(entry, feat_ids, axis_name=axis_name,
+                                  strategy=self.lookup_strategy)
+        table_of = self._table_of(feat_ids)
+        out = None
+        for i, b in enumerate(self.buckets):
+            bucket = emb_ops.hash_bucket(feat_ids, b, salt=i + 1)
+            part = jnp.take(entry[f"t{i}"], bucket, axis=0)
+            sel = (table_of == i).astype(part.dtype)
+            sel = sel.reshape(sel.shape + (1,) * (part.ndim - sel.ndim))
+            part = part * sel
+            out = part if out is None else out + part
+        return out
+
+    # -- sparse-update plan ---------------------------------------------
+    def sparse_plan(self, feat_ids: jnp.ndarray,
+                    num_rows: Optional[int] = None
+                    ) -> Dict[str, emb_ops.PlanEntry]:
+        """One batch's dedup plan per table. ``num_rows`` overrides the
+        monolithic OOB fill id (the tiered runtime feeds SLOT ids, whose
+        table is embedding_hot_rows tall — padded_vocab still works as the
+        fill because slots < hot_rows < padded_vocab, but an explicit
+        override keeps intent readable)."""
+        if not self.hashed:
+            rows = self.padded_vocab if num_rows is None else int(num_rows)
+            return {self.MONO: emb_ops.make_plan(feat_ids, rows)}
+        table_of = self._table_of(feat_ids)
+        plan = {}
+        for i, b in enumerate(self.buckets):
+            bucket = emb_ops.hash_bucket(feat_ids, b, salt=i + 1)
+            sel = table_of == i
+            per_table = jnp.where(sel, bucket, jnp.int32(b))  # OOB when not ours
+            plan[f"t{i}"] = emb_ops.make_plan(
+                per_table, b, mask=sel.astype(jnp.float32))
+        return plan
+
+    def tables(self, entry: Any) -> Dict[str, jax.Array]:
+        """Uniform dict view of an entry: {key: [rows, ...] table}."""
+        return entry if self.hashed else {self.MONO: entry}
+
+    def from_tables(self, tables: Dict[str, jax.Array]) -> Any:
+        return tables if self.hashed else tables[self.MONO]
+
+    def gather_rows(self, entry: Any, plan: Dict[str, emb_ops.PlanEntry]
+                    ) -> Dict[str, jax.Array]:
+        """Touched rows per table — the sparse path's gradient leaf."""
+        tabs = self.tables(entry)
+        return {k: emb_ops.gather_rows(tabs[k], plan[k]) for k in plan}
+
+    def lookup_rows(self, rows: Dict[str, jax.Array],
+                    plan: Dict[str, emb_ops.PlanEntry]) -> jnp.ndarray:
+        """[B,F,*trailing] forward view over pre-gathered rows."""
+        out = None
+        for k in plan:
+            part = emb_ops.lookup_rows(rows[k], plan[k])
+            out = part if out is None else out + part
+        return out
+
+    # -- regularization -------------------------------------------------
+    def l2(self, entry: Any, *, axis_name: Optional[str] = None
+           ) -> jnp.ndarray:
+        """0.5*sum(x^2) over REAL rows only — padded_vocab pad rows are
+        structurally excluded (they are zero, so the value is unchanged;
+        the exclusion guarantees their gradient is exactly zero by
+        construction, not by reachability argument)."""
+        if self.hashed:
+            return sum(l2_half_sum(t) for t in entry.values())
+        keep = emb_ops.pad_row_mask(entry.shape[0], self.feature_size,
+                                    axis_name)
+        keep = keep.reshape((-1,) + (1,) * (entry.ndim - 1))
+        sq = jnp.square(entry.astype(jnp.float32))
+        return 0.5 * jnp.sum(jnp.where(keep, sq, jnp.zeros((), sq.dtype)))
+
+    def l2_rows(self, rows: Dict[str, jax.Array],
+                plan: Dict[str, emb_ops.PlanEntry]) -> jnp.ndarray:
+        """Sparse-mode L2 over the batch's TOUCHED rows only (OOB fill
+        slots excluded). Deliberate deviation from dense L2 — idle rows do
+        not decay between touches; TUNING §2.11 quantifies the drift."""
+        total = None
+        for k, entry in plan.items():
+            valid = emb_ops.valid_rows(entry).astype(jnp.float32)
+            valid = valid.reshape((-1,) + (1,) * (rows[k].ndim - 1))
+            sq = jnp.square(rows[k].astype(jnp.float32)) * valid
+            s = 0.5 * jnp.sum(sq)
+            total = s if total is None else total + s
+        return total
+
+    def mask_pad_grads(self, grad_entry: Any, *,
+                       axis_name: Optional[str] = None) -> Any:
+        """Zero pad-row gradients on the dense path (hashed tables have no
+        pad rows — every bucket is reachable)."""
+        if self.hashed:
+            return grad_entry
+        return emb_ops.mask_pad_rows(grad_entry, self.feature_size,
+                                     axis_name)

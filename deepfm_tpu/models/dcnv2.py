@@ -15,13 +15,12 @@ b + dense(concat(cross_out, deep_out)).
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from ..config import Config
-from ..ops import embedding as emb_ops
 from . import common
 from .deepfm import DeepFM
 
@@ -69,13 +68,15 @@ class DCNv2(DeepFM):
         rng: Optional[jax.Array] = None,
         shard_axis: Optional[str] = None,
         data_axis: Optional[str] = None,
+        emb_rows: Optional[Dict[str, Any]] = None,
+        emb_plan: Optional[Dict[str, Any]] = None,
     ) -> Tuple[jnp.ndarray, common.State]:
         cfg = self.cfg
         cdt = jnp.dtype(cfg.compute_dtype)
         feat_vals = feat_vals.astype(jnp.float32)
 
-        v = emb_ops.lookup(params["fm_v"], feat_ids, axis_name=shard_axis,
-                           strategy=cfg.embedding_lookup)
+        v = self._emb_lookup(params, "fm_v", feat_ids, shard_axis,
+                             emb_rows, emb_plan)
         xv = v * feat_vals[..., None]
         x0 = xv.reshape(xv.shape[0], cfg.field_size * cfg.embedding_size)
 

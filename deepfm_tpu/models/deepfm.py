@@ -20,7 +20,6 @@ import jax
 import jax.numpy as jnp
 
 from ..config import Config
-from ..ops import embedding as emb_ops
 from ..ops import fm as fm_ops
 from ..ops import pallas_fm
 from . import common
@@ -31,19 +30,15 @@ class DeepFM:
 
     def __init__(self, cfg: Config):
         self.cfg = cfg
-        self.padded_vocab = emb_ops.padded_vocab(cfg.feature_size, cfg.mesh_model)
+        self.emb = common.EmbeddingSchema(cfg)
+        self.padded_vocab = self.emb.padded_vocab
 
     # -- parameters ----------------------------------------------------
     def init(self, rng: jax.Array) -> Tuple[common.Params, common.State]:
         cfg = self.cfg
         k_w, k_v, k_mlp = jax.random.split(rng, 3)
-        fm_w = common.glorot_normal(k_w, (cfg.feature_size,))
-        fm_v = common.glorot_normal(k_v, (cfg.feature_size, cfg.embedding_size))
-        if self.padded_vocab != cfg.feature_size:
-            pad = self.padded_vocab - cfg.feature_size
-            fm_w = jnp.concatenate([fm_w, jnp.zeros((pad,), fm_w.dtype)])
-            fm_v = jnp.concatenate(
-                [fm_v, jnp.zeros((pad, cfg.embedding_size), fm_v.dtype)])
+        fm_w = self.emb.init_entry(k_w, ())
+        fm_v = self.emb.init_entry(k_v, (cfg.embedding_size,))
         tower, bn_state = common.init_tower(
             k_mlp, cfg.field_size * cfg.embedding_size, cfg.deep_layer_sizes,
             cfg.batch_norm)
@@ -63,16 +58,18 @@ class DeepFM:
         rng: Optional[jax.Array] = None,
         shard_axis: Optional[str] = None,
         data_axis: Optional[str] = None,
+        emb_rows: Optional[Dict[str, Any]] = None,
+        emb_plan: Optional[Dict[str, Any]] = None,
     ) -> Tuple[jnp.ndarray, common.State]:
         cfg = self.cfg
         feat_vals = feat_vals.astype(jnp.float32)
 
         # First-order: sum_f W[ids]*vals   (reference :177-179)
-        w = emb_ops.lookup(params["fm_w"], feat_ids, axis_name=shard_axis,
-                           strategy=cfg.embedding_lookup)  # [B,F]
+        w = self._emb_lookup(params, "fm_w", feat_ids, shard_axis,
+                             emb_rows, emb_plan)  # [B,F]
         # Second-order FM over xv = V[ids]*vals   (reference :181-187)
-        v = emb_ops.lookup(params["fm_v"], feat_ids, axis_name=shard_axis,
-                           strategy=cfg.embedding_lookup)  # [B,F,K]
+        v = self._emb_lookup(params, "fm_v", feat_ids, shard_axis,
+                             emb_rows, emb_plan)  # [B,F,K]
         xv = v * feat_vals[..., None]
         if cfg.use_pallas and pallas_fm.supported(cfg.field_size,
                                                  cfg.embedding_size):
@@ -97,11 +94,33 @@ class DeepFM:
         logits = params["fm_b"][0] + y_wv + y_d  # [B] (reference :229-231)
         return logits, new_state
 
+    def _emb_lookup(self, params: common.Params, name: str,
+                    feat_ids: jnp.ndarray, shard_axis: Optional[str],
+                    emb_rows: Optional[Dict[str, Any]],
+                    emb_plan: Optional[Dict[str, Any]]) -> jnp.ndarray:
+        """Dense gather from the full table, or (sparse-update path) the
+        batch's pre-gathered touched rows — ``emb_rows[name]`` is the
+        gradient leaf there, so AD of this inverse-index gather lowers to
+        the batch-sized segment-sum scatter instead of a full-table one."""
+        if emb_rows is not None:
+            return self.emb.lookup_rows(emb_rows[name], emb_plan)
+        return self.emb.lookup(params[name], feat_ids, axis_name=shard_axis)
+
     # -- regularization -------------------------------------------------
-    def l2_loss(self, params: common.Params) -> jnp.ndarray:
-        """l2_reg * (l2_loss(FM_W) + l2_loss(FM_V)) — reference :244-246."""
+    def l2_loss(self, params: common.Params, *,
+                shard_axis: Optional[str] = None,
+                emb_rows: Optional[Dict[str, Any]] = None,
+                emb_plan: Optional[Dict[str, Any]] = None) -> jnp.ndarray:
+        """l2_reg * (l2_loss(FM_W) + l2_loss(FM_V)) — reference :244-246.
+        Pad rows are structurally excluded; the sparse path penalizes only
+        the batch's touched rows (TUNING §2.11)."""
+        if emb_rows is not None:
+            return self.cfg.l2_reg * (
+                self.emb.l2_rows(emb_rows["fm_w"], emb_plan)
+                + self.emb.l2_rows(emb_rows["fm_v"], emb_plan))
         return self.cfg.l2_reg * (
-            common.l2_half_sum(params["fm_w"]) + common.l2_half_sum(params["fm_v"]))
+            self.emb.l2(params["fm_w"], axis_name=shard_axis)
+            + self.emb.l2(params["fm_v"], axis_name=shard_axis))
 
     def embedding_param_names(self) -> Tuple[str, ...]:
         """Top-level param keys that are row-sharded over the model axis."""

@@ -7,13 +7,12 @@ the second-order FM term, keeping y = b + wide(ids, vals) + DNN(xv).
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from ..config import Config
-from ..ops import embedding as emb_ops
 from . import common
 from .deepfm import DeepFM
 
@@ -32,18 +31,20 @@ class WideDeep(DeepFM):
         rng: Optional[jax.Array] = None,
         shard_axis: Optional[str] = None,
         data_axis: Optional[str] = None,
+        emb_rows: Optional[Dict[str, Any]] = None,
+        emb_plan: Optional[Dict[str, Any]] = None,
     ) -> Tuple[jnp.ndarray, common.State]:
         cfg = self.cfg
         feat_vals = feat_vals.astype(jnp.float32)
 
         # Wide: linear over sparse features (first-order part of DeepFM).
-        w = emb_ops.lookup(params["fm_w"], feat_ids, axis_name=shard_axis,
-                           strategy=cfg.embedding_lookup)
+        w = self._emb_lookup(params, "fm_w", feat_ids, shard_axis,
+                             emb_rows, emb_plan)
         y_wide = jnp.sum(w * feat_vals, axis=1)
 
         # Deep: tower over embedded features.
-        v = emb_ops.lookup(params["fm_v"], feat_ids, axis_name=shard_axis,
-                           strategy=cfg.embedding_lookup)
+        v = self._emb_lookup(params, "fm_v", feat_ids, shard_axis,
+                             emb_rows, emb_plan)
         xv = v * feat_vals[..., None]
         deep_in = xv.reshape(xv.shape[0], cfg.field_size * cfg.embedding_size)
         y_d, new_state = common.apply_tower(

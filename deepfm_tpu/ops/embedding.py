@@ -14,7 +14,7 @@ the local shard and ``ids`` are the (replicated-over-model) global indices.
 from __future__ import annotations
 
 import math
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -134,3 +134,141 @@ def _warn_mesh_dependent_padding(num_shards: int) -> None:
 
 
 _pad_warned = False
+
+
+# ---------------------------------------------------------------------------
+# Deterministic id hashing (multi-table bucketed embeddings)
+# ---------------------------------------------------------------------------
+# Stateless uint32 mixing (Knuth multiplicative + murmur3-style finalizer):
+# determinism across processes, restarts and resume comes for free because
+# the mapping is pure arithmetic — no dictionaries, no RNG, no host state.
+# All math stays in uint32 (JAX_ENABLE_X64 off in tests and on TPU).
+
+_KNUTH = jnp.uint32(2654435761)       # 2^32 / golden ratio
+_MIX1 = jnp.uint32(0x85EBCA6B)        # murmur3 fmix32 constants
+_MIX2 = jnp.uint32(0xC2B2AE35)
+TABLE_ASSIGN_SALT = 0x9E3779B9        # distinct stream for table selection
+
+
+def hash_mix(ids: jax.Array, salt: int) -> jax.Array:
+    """Avalanche-mix ids (any int dtype) into uniform uint32, salted so each
+    consumer (table assignment, each table's bucketing) draws an independent
+    stream from the same id."""
+    x = ids.astype(jnp.uint32) ^ jnp.uint32(salt)
+    x = x * _KNUTH
+    x = x ^ (x >> 16)
+    x = x * _MIX1
+    x = x ^ (x >> 13)
+    x = x * _MIX2
+    x = x ^ (x >> 16)
+    return x
+
+
+def hash_bucket(ids: jax.Array, num_buckets: int, salt: int) -> jax.Array:
+    """Bucket index in [0, num_buckets) for each id — table ``salt`` gives
+    every table an independent bucketing, so two ids colliding in one table
+    almost surely separate in another."""
+    return (hash_mix(ids, salt) % jnp.uint32(num_buckets)).astype(jnp.int32)
+
+
+def hash_table_assign(ids: jax.Array, num_tables: int) -> jax.Array:
+    """Table index in [0, num_tables) per id (embedding_assign="hash")."""
+    return (hash_mix(ids, TABLE_ASSIGN_SALT)
+            % jnp.uint32(num_tables)).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Sparse-update plan: static-shape dedup of one batch's ids
+# ---------------------------------------------------------------------------
+
+
+class PlanEntry(NamedTuple):
+    """Dedup of one batch's ids against ONE physical table.
+
+    uids: int32 [U]    sorted unique row ids; U = ids.size (static). Slots
+                       beyond the real uniques hold ``num_rows`` — OUT OF
+                       BOUNDS by construction, so gathers read zero
+                       (mode="fill") and scatters drop them: no sentinel
+                       row and no dynamic shapes needed.
+    inv:  int32 [...]  ids-shaped map position -> uid slot.
+    mask: f32   [...]  1.0 where the position reads this table (hashed
+                       multi-table assignment), else 0.0. None = all
+                       positions (monolithic table).
+    num_rows: int      static row count used as the OOB fill id.
+    """
+    uids: jax.Array
+    inv: jax.Array
+    mask: Optional[jax.Array]
+    num_rows: int
+
+
+def make_plan(ids: jax.Array, num_rows: int,
+              mask: Optional[jax.Array] = None) -> PlanEntry:
+    """Build a PlanEntry. ``ids`` must already be per-table row ids; masked
+    positions must carry the OOB value ``num_rows`` (they then share the
+    unique fill value and vanish in the drop-scatter)."""
+    flat = ids.reshape(-1).astype(jnp.int32)
+    uids, inv = jnp.unique(
+        flat, size=flat.shape[0], fill_value=num_rows, return_inverse=True)
+    return PlanEntry(uids=uids, inv=inv.reshape(ids.shape).astype(jnp.int32),
+                     mask=mask, num_rows=num_rows)
+
+
+def valid_rows(entry: PlanEntry) -> jax.Array:
+    """Bool [U]: which uid slots name a real (in-bounds) touched row."""
+    return entry.uids < entry.num_rows
+
+
+def gather_rows(table: jax.Array, entry: PlanEntry) -> jax.Array:
+    """[U, ...] rows at ``entry.uids``. OOB fill slots read as ZERO
+    (``mode="fill"`` — jnp.take's default fill is NaN, which would poison
+    any masked-multiply downstream). Fill-slot values are never referenced
+    by ``inv`` and their updates are dropped by the OOB scatter; zeros keep
+    them inert in sums/l2 as well."""
+    return jnp.take(table, entry.uids, axis=0, mode="fill", fill_value=0)
+
+
+def lookup_rows(rows: jax.Array, entry: PlanEntry) -> jax.Array:
+    """Positionwise view of gathered rows: rows[inv] (masked in hashed
+    mode). Differentiating this gather w.r.t. ``rows`` IS the segment-sum:
+    the transpose is a scatter-add of the per-position cotangents into [U]
+    row slots — cost ∝ batch, never ∝ vocab."""
+    out = jnp.take(rows, entry.inv, axis=0)
+    if entry.mask is not None:
+        mask = entry.mask.reshape(
+            entry.mask.shape + (1,) * (out.ndim - entry.mask.ndim))
+        out = out * mask
+    return out
+
+
+def scatter_rows(table: jax.Array, entry: PlanEntry,
+                 new_rows: jax.Array) -> jax.Array:
+    """Write back updated touched rows; the OOB fill slots are DROPPED by
+    XLA's default scatter mode, so unique's padding can never alias a real
+    row. Distinct in-bounds uids make the scatter duplicate-free and
+    deterministic."""
+    return table.at[entry.uids].set(new_rows)
+
+
+def pad_row_mask(num_rows_local: int, feature_size: int,
+                 axis_name: Optional[str] = None) -> jax.Array:
+    """Bool [num_rows_local]: True for real vocabulary rows, False for
+    ``padded_vocab`` padding. Inside shard_map the table is a local shard;
+    ``axis_name`` recovers the global row index."""
+    row = jnp.arange(num_rows_local)
+    if axis_name is not None:
+        row = row + jax.lax.axis_index(axis_name) * num_rows_local
+    return row < feature_size
+
+
+def mask_pad_rows(x: jax.Array, feature_size: int,
+                  axis_name: Optional[str] = None) -> jax.Array:
+    """Zero the padded_vocab pad rows of a table-shaped array (used on
+    dense embedding grads: pad rows are unreachable so their grads are
+    already zero — this makes the exclusion a structural guarantee rather
+    than an emergent property)."""
+    if axis_name is None and x.shape[0] <= feature_size:
+        return x
+    keep = pad_row_mask(x.shape[0], feature_size, axis_name)
+    keep = keep.reshape((-1,) + (1,) * (x.ndim - 1))
+    return jnp.where(keep, x, jnp.zeros((), x.dtype))

@@ -43,6 +43,7 @@ except ImportError:  # jax < 0.5: experimental location, check_rep kwarg
 
 from ..config import Config
 from ..models import get_model
+from ..ops import embedding as emb_ops
 from ..parallel import mesh as mesh_lib
 from ..utils import logging as ulog
 from ..utils import profiling as prof_lib
@@ -113,6 +114,28 @@ class Trainer:
         self._donate_state = cfg.on_nonfinite != "skip"
         # Injectable watchdog abort (tests); None = os._exit(EXIT_WATCHDOG).
         self.watchdog_abort: Optional[Callable[[str], None]] = None
+        # Sparse (touched-rows-only) embedding updates: single-device jit
+        # path only — under a mesh the per-shard plans would desync the
+        # replicated tables, so fall back to dense rather than diverge.
+        self.sparse_embed = cfg.embedding_update == "sparse"
+        if self.sparse_embed and self.mesh_info.mesh is not None:
+            ulog.warning(
+                "embedding_update=sparse supports the single-device jit "
+                "path only; a mesh is present -> falling back to dense "
+                "embedding updates")
+            self.sparse_embed = False
+        self._embed_names = tuple(self.model.embedding_param_names())
+        self._sparse_lr = cfg.learning_rate  # world == 1 on the sparse path
+        # Hot/cold tiered embedding storage (requires the sparse path).
+        self._tier: Optional[Any] = None
+        if cfg.embedding_tiering == "hot_cold":
+            if not self.sparse_embed:
+                raise ValueError(
+                    "embedding_tiering=hot_cold requires the sparse "
+                    "single-device update path (a mesh forced the dense "
+                    "fallback)")
+            from ..data import hot_cold  # noqa: PLC0415
+            self._tier = hot_cold.TieredEmbeddingRuntime(cfg, self.model)
 
     # ------------------------------------------------------------------
     # State creation / placement
@@ -124,9 +147,27 @@ class Trainer:
         rng = jax.random.PRNGKey(seed)
         k_init, k_state = jax.random.split(rng)
         params, model_state = self.model.init(k_init)
-        opt_state = self.tx.init(params)
+        opt_state = self._init_opt_state(params)
         state = TrainState.create(params, opt_state, model_state, k_state)
-        return self._place(state)
+        state = self._place(state)
+        if self._tier is not None:
+            state = self._tier.adopt(state)
+        return state
+
+    def _init_opt_state(self, params) -> Any:
+        """Dense: the optax state over all params. Sparse: the optax state
+        over the NON-embedding params plus per-table lazy-Adam slots
+        (m/v/tau) and one global step counter for the embeddings."""
+        if not self.sparse_embed:
+            return self.tx.init(params)
+        rest = {k: v for k, v in params.items()
+                if k not in self._embed_names}
+        embed = {
+            name: {k: opt_lib.embed_adam_init(t)
+                   for k, t in self.model.emb.tables(params[name]).items()}
+            for name in self._embed_names}
+        return {"base": self.tx.init(rest), "embed": embed,
+                "count": jnp.zeros((), jnp.int32)}
 
     def _state_specs(self, state: TrainState) -> TrainState:
         param_specs = mesh_lib.param_pspecs(
@@ -185,6 +226,8 @@ class Trainer:
                    ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
         """One optimizer step (raw, mesh-axis-aware; wrapped by jit/shard_map
         in _make_train_step and scanned in _make_train_multi_step)."""
+        if self.sparse_embed and data_axis is None and shard_axis is None:
+            return self._sparse_step_impl(state, batch)
         rng = jax.random.fold_in(state.rng, state.step)
         if data_axis is not None:
             # Distinct dropout per data shard; identical across model
@@ -211,8 +254,83 @@ class Trainer:
 
         (_, (xent, l2, new_mstate)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
+        # Structural guarantee: padded_vocab pad rows never receive a
+        # gradient (they are zero already — unreachable ids, masked l2 —
+        # so this is bit-neutral; the regression test pins it).
+        grads = {**grads, **{
+            n: self.model.emb.mask_pad_grads(grads[n], axis_name=shard_axis)
+            for n in self._embed_names}}
         updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1, params=new_params, opt_state=new_opt,
+            model_state=new_mstate)
+        return new_state, {"loss": xent + l2, "xent": xent}
+
+    def _sparse_step_impl(self, state: TrainState, batch
+                          ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
+        """One sparse-update optimizer step (single-device path).
+
+        The batch's ids are deduped into a per-table plan; the TOUCHED ROWS
+        — not the tables — are the differentiated leaf, so AD of the
+        inverse-index gather in the forward lowers to a batch-sized
+        segment-sum scatter-add instead of a [vocab, ...] cotangent, and
+        lazy timestamped Adam (optimizers.sparse_adam_rows) touches only
+        those rows. Per-step cost scales with unique-ids-per-batch, never
+        with vocab size (EMBED_r01.json pins the scaling curve)."""
+        emb = self.model.emb
+        rng = jax.random.fold_in(state.rng, state.step)
+        plan = emb.sparse_plan(batch["feat_ids"])
+        rows0 = {n: emb.gather_rows(state.params[n], plan)
+                 for n in self._embed_names}
+        rest0 = {k: v for k, v in state.params.items()
+                 if k not in self._embed_names}
+
+        def loss_fn(diff):
+            rows, rest = diff
+            params = {**rest,
+                      **{n: state.params[n] for n in self._embed_names}}
+            logits, new_mstate = self.model.apply(
+                params, state.model_state, batch["feat_ids"],
+                batch["feat_vals"], train=True, rng=rng,
+                shard_axis=None, data_axis=None,
+                emb_rows=rows, emb_plan=plan)
+            labels = batch["label"].reshape(-1).astype(jnp.float32)
+            xent = jnp.mean(self._per_example_loss(logits, labels))
+            # Touched-rows-only L2 (deliberate deviation from dense L2 —
+            # idle rows do not decay between touches; TUNING §2.11).
+            l2 = self.model.l2_loss(params, emb_rows=rows, emb_plan=plan)
+            return xent + l2, (xent, l2, new_mstate)
+
+        (_, (xent, l2, new_mstate)), (g_rows, g_rest) = jax.value_and_grad(
+            loss_fn, has_aux=True)((rows0, rest0))
+
+        opt = state.opt_state
+        upd_rest, new_base = self.tx.update(g_rest, opt["base"], rest0)
+        new_rest = optax.apply_updates(rest0, upd_rest)
+        count = opt["count"] + 1
+        new_params = dict(new_rest)
+        new_embed = {}
+        for name in self._embed_names:
+            tabs = emb.tables(state.params[name])
+            new_tabs: Dict[str, jax.Array] = {}
+            new_opt_t: Dict[str, Any] = {}
+            for key, e in plan.items():
+                oe = opt["embed"][name][key]
+                new_rows, new_m, new_v = opt_lib.sparse_adam_rows(
+                    rows0[name][key], g_rows[name][key],
+                    emb_ops.gather_rows(oe.m, e),
+                    emb_ops.gather_rows(oe.v, e),
+                    emb_ops.gather_rows(oe.tau, e),
+                    count, lr=self._sparse_lr)
+                new_tabs[key] = emb_ops.scatter_rows(tabs[key], e, new_rows)
+                new_opt_t[key] = opt_lib.EmbedAdamEntry(
+                    m=emb_ops.scatter_rows(oe.m, e, new_m),
+                    v=emb_ops.scatter_rows(oe.v, e, new_v),
+                    tau=oe.tau.at[e.uids].set(count))
+            new_params[name] = emb.from_tables(new_tabs)
+            new_embed[name] = new_opt_t
+        new_opt = {"base": new_base, "embed": new_embed, "count": count}
         new_state = state.replace(
             step=state.step + 1, params=new_params, opt_state=new_opt,
             model_state=new_mstate)
@@ -464,7 +582,7 @@ class Trainer:
     def _abstract_state_for_specs(self) -> TrainState:
         rng = jax.random.PRNGKey(0)
         params, model_state = self.model.init(rng)
-        opt_state = self.tx.init(params)
+        opt_state = self._init_opt_state(params)
         return TrainState.create(params, opt_state, model_state, rng)
 
     # ------------------------------------------------------------------
@@ -533,6 +651,39 @@ class Trainer:
                     group = []
             for b in group:
                 yield self.put_batch(b), 1, b["label"].shape[0]
+
+        if depth <= 0:
+            return gen()
+        from ..data.pipeline import _prefetch  # noqa: PLC0415
+        return _prefetch(gen(), depth)
+
+    def _stage_tiered(self, batches: Iterable[Dict[str, np.ndarray]],
+                      k: int, depth: int):
+        """Tiered staging: same grouping contract as ``_stage``, but every
+        group is routed through the hot/cold runtime on the staging thread
+        — plan the cache transaction, PREFETCH missing cold rows (the fetch
+        for dispatch t+1 overlaps the device computing dispatch t when
+        ``depth`` > 0), and remap ``feat_ids`` to hot slot ids — before the
+        host->device transfer. Plan order == yield order == dispatch order;
+        the fit loop pops one plan per yielded group via
+        ``_tier.apply_next``."""
+
+        def stage_group(group):
+            n_ex = sum(g["label"].shape[0] for g in group)
+            remapped = self._tier.plan_group(group)
+            if len(remapped) == 1:
+                return self.put_batch(remapped[0]), 1, n_ex
+            return self.put_superbatch(remapped), len(remapped), n_ex
+
+        def gen():
+            group = []
+            for b in batches:
+                group.append(b)
+                if len(group) == k:
+                    yield stage_group(group)
+                    group = []
+            for b in group:
+                yield stage_group([b])
 
         if depth <= 0:
             return gen()
@@ -710,7 +861,11 @@ class Trainer:
             import itertools  # noqa: PLC0415
             batches = itertools.islice(iter(batches), max_steps)
         depth = cfg.transfer_ahead
-        if world > 1:
+        if self._tier is not None:
+            # Hot/cold tiering: plan + prefetch + slot remap on the staging
+            # thread (single-process single-device by construction).
+            staged_iter = self._stage_tiered(batches, k, depth)
+        elif world > 1:
             # Lockstep min-truncate protocol + background transfer: all
             # collectives (the count allgathers AND the step programs) are
             # enqueued on THIS thread in the same order on every rank; only
@@ -731,6 +886,12 @@ class Trainer:
         meter = prof_lib.ThroughputMeter()
         try:
             for dev_batch, steps_done, local_ex in staged_iter:
+                if self._tier is not None:
+                    # Install this dispatch's fetched cold rows BEFORE the
+                    # guard's prev_state snapshot: a skipped dispatch then
+                    # still retains its installs, keeping the directory and
+                    # the device cache consistent.
+                    state = self._tier.apply_next(state)
                 if guard_active:
                     # Donation is off under skip (see __init__), so the
                     # pre-dispatch state stays valid for a dropped update.
@@ -1120,6 +1281,10 @@ class Trainer:
         none double-counts), and under multi-process ``lockstep_batches``
         keeps the eval_step collectives aligned — a rank whose shard is
         exhausted feeds zero-weight dummy batches until every rank is done."""
+        if self._tier is not None:
+            # Offline eval runs the ordinary dense forward over the full
+            # table (flushed hot rows + cold store).
+            state = self._tier.densified(state)
         cfg = self.cfg
         world = jax.process_count() if self.mesh_info.mesh is not None else 1
         local_bs = cfg.batch_size // world
@@ -1251,6 +1416,8 @@ class Trainer:
         dispatch. A caller feeding a constant-shape padded stream (the infer
         task) gets the amortized path automatically, and per-batch yield
         order is preserved either way."""
+        if self._tier is not None:
+            state = self._tier.densified(state)
         k = max(self.cfg.steps_per_loop, 1)
         group: list = []
         for batch in batches:

@@ -86,6 +86,76 @@ def ftrl(
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+# ---------------------------------------------------------------------------
+# Sparse (touched-rows-only) Adam with lazy, timestamped moment correction
+# ---------------------------------------------------------------------------
+#
+# Dense Adam updates EVERY table row every step: touched rows get the full
+# update, idle rows still move by their decaying momentum tail
+# (-lr * b1^k*m_hat / (sqrt(b2^k*v_hat)+eps)). Applying Adam only to the
+# batch's touched rows therefore cannot be bit-exact — but the idle-row
+# tail is bounded (a geometric series, ≲ lr*(sum_k b1^k/sqrt(b2^k)) ≈ 9*lr
+# per idle stretch, far less in practice because v decays slower than m),
+# so the trajectories agree within a pinned tolerance (tests).
+#
+# The lazy correction makes a touched row's update IDENTICAL to what dense
+# Adam would compute for it: per row we store (m, v) and the step count
+# ``tau`` at which the row was last touched. On a touch at global step
+# ``count`` (1-based, optax convention):
+#
+#     m_t = b1^(count-tau) * m_stored + (1 - b1) * g       # k idle steps
+#     v_t = b2^(count-tau) * v_stored + (1 - b2) * g^2     # decayed in O(1)
+#     update = -lr * (m_t / (1-b1^count)) / (sqrt(v_t / (1-b2^count)) + eps)
+#
+# which is exactly optax.scale_by_adam's m/v for that row had the zero
+# gradients been applied one step at a time — the decay factors simply
+# telescope. Cost per step ∝ unique touched rows, never ∝ vocab.
+
+
+class EmbedAdamEntry(NamedTuple):
+    """Per-table lazy-Adam slots. ``tau`` is int32 [rows]: the global step
+    count at which the row's (m, v) were last brought current."""
+    m: jax.Array
+    v: jax.Array
+    tau: jax.Array
+
+
+def embed_adam_init(table: jax.Array) -> EmbedAdamEntry:
+    return EmbedAdamEntry(
+        m=jnp.zeros_like(table, jnp.float32),
+        v=jnp.zeros_like(table, jnp.float32),
+        tau=jnp.zeros((table.shape[0],), jnp.int32),
+    )
+
+
+def sparse_adam_rows(
+    rows0: jax.Array,      # f32 [U, ...] touched rows (pre-update values)
+    g_rows: jax.Array,     # f32 [U, ...] summed per-row gradient
+    m_rows: jax.Array,     # f32 [U, ...] stored first moment at uids
+    v_rows: jax.Array,     # f32 [U, ...] stored second moment at uids
+    tau_rows: jax.Array,   # int32 [U]    last-touch step count at uids
+    count: jax.Array,      # int32 []     global step count AFTER this step
+    *,
+    lr: float,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+):
+    """One lazy-Adam step on gathered rows; returns (new_rows, new_m,
+    new_v). Pure row-space math — the caller owns gather/scatter, so the
+    tiered runtime can reuse this on hot-cache slots unchanged."""
+    g = g_rows.astype(jnp.float32)
+    cnt = count.astype(jnp.float32)
+    idle = (count - tau_rows).astype(jnp.float32)  # [U] steps since touch
+    idle = idle.reshape(idle.shape + (1,) * (g.ndim - 1))
+    m = jnp.power(b1, idle) * m_rows + (1.0 - b1) * g
+    v = jnp.power(b2, idle) * v_rows + (1.0 - b2) * jnp.square(g)
+    m_hat = m / (1.0 - jnp.power(b1, cnt))
+    v_hat = v / (1.0 - jnp.power(b2, cnt))
+    new_rows = rows0.astype(jnp.float32) - lr * m_hat / (jnp.sqrt(v_hat) + eps)
+    return new_rows.astype(rows0.dtype), m, v
+
+
 def build_optimizer(cfg: Config, *, world_size: int = 1) -> optax.GradientTransformation:
     lr = cfg.learning_rate
     if cfg.scale_lr_by_world and world_size > 1:

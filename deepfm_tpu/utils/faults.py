@@ -122,6 +122,32 @@ def check_publish_crash(stage: str) -> None:
     raise InjectedFault(f"injected publish crash at stage {stage!r}")
 
 
+# Hot/cold tiered-embedding seam (data/hot_cold.py): arm N one-shot cold-
+# store fetch failures; the runtime's fetch retry must heal them without
+# corrupting the hot cache or the training trajectory (tests/test_hot_cold).
+
+_cold_fetch_lock = threading.Lock()
+_cold_fetch_fails: int = 0
+
+
+def set_cold_fetch_plan(fail_count: int) -> None:
+    """Arm the next ``fail_count`` cold-store fetches to raise (one fault
+    per fetch call; the runtime's retry consumes them)."""
+    global _cold_fetch_fails
+    with _cold_fetch_lock:
+        _cold_fetch_fails = int(fail_count)
+
+
+def check_cold_fetch() -> None:
+    """Called by the cold store at each fetch; raises while armed."""
+    global _cold_fetch_fails
+    with _cold_fetch_lock:
+        if _cold_fetch_fails <= 0:
+            return
+        _cold_fetch_fails -= 1
+    raise InjectedFault("injected cold-store fetch failure")
+
+
 # Env seam for subprocess drills (scripts/online_drill.py): the train task
 # calls install_env_faults() at startup; with DEEPFM_TPU_READ_FAULT_EVERY=k
 # set, a process-wide FlakyFS making every k-th read fail once is installed,

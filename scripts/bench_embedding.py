@@ -1,15 +1,30 @@
 #!/usr/bin/env python
-"""A/B the row-sharded embedding lookup strategies (SURVEY hard-part #1).
+"""Embedding-scale benchmark: sparse-vs-dense updates, beyond-HBM vocab
+scaling, and hot/cold tiering overlap. Emits ``EMBED_r01.json``.
 
-Compares ``masked_psum`` (local masked gather + psum of activations) vs
-``allgather_table`` (reassemble table, plain gather) under shard_map on a
-virtual 8-device mesh: forward+backward wall time at CTR shapes, plus the
-analytic per-step collective traffic that decides the winner on real ICI
-(virtual CPU devices share one memory — the timing here captures compute
-and program overhead only, NOT interconnect cost; the bytes column is the
-hardware-relevant signal).
+Sections (all single-device; the legacy sharded lookup-strategy A/B is
+kept behind ``--sharded``):
 
-Usage: python scripts/bench_embedding.py [--devices 8]
+* ``sparse_vs_dense`` — identical synthetic CTR training with
+  ``--embedding_update dense`` vs ``sparse``: ms/step A/B plus the final
+  max param divergence (the lazy-Adam idle-row tail; see
+  tests/test_embedding_sparse.py for the pinned tolerance).
+* ``scaling`` — sparse ms/step over 1M/10M/100M *hashed* vocabs with the
+  physical tables capped by ``--embedding_buckets``, and over batch sizes
+  at the largest vocab. The claim under test: sparse step cost scales
+  with unique-ids-per-batch, NOT with vocab (dense at 100M would update
+  every row every step — it isn't even run above the base vocab).
+* ``hot_cold`` — tiered training (HBM-hot cache over host cold store) at
+  lookahead depth 0 vs 2: hit rate, cold-fetch wall time, and the
+  fraction of fetch time that ran on the staging thread overlapped with
+  device compute (the ``overlap`` column; acceptance is >= 0.5 at
+  depth 2).
+
+Honesty labels: ``device_kind`` records what the timings ran on (CPU
+numbers are A/B-relative, not TPU-absolute); ``load_kind`` records that
+the input is synthetic CTR, not Criteo.
+
+Usage: python scripts/bench_embedding.py [--quick] [--sharded] [--out X]
 """
 
 import argparse
@@ -20,10 +35,149 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from __graft_entry__ import _provision_virtual_devices  # noqa: E402
+
+def _synth_batches(nb, b, f, v, seed=3):
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(nb):
+        out.append(dict(
+            feat_ids=rng.integers(0, v, size=(b, f)).astype(np.int64 if
+                                  v > 2**31 - 1 else np.int32),
+            feat_vals=rng.normal(size=(b, f)).astype(np.float32),
+            label=rng.integers(0, 2, size=(b,)).astype(np.float32)))
+    return out
 
 
-def bench(v: int, k: int, b: int, f: int, m: int, data: int) -> None:
+def _mean_unique(batches):
+    import numpy as np
+    return float(np.mean([np.unique(b["feat_ids"]).size for b in batches]))
+
+
+def _cfg(**kw):
+    from deepfm_tpu.config import Config
+    base = dict(field_size=39, embedding_size=8, deep_layers="32,16",
+                dropout="1.0,1.0", compute_dtype="float32", l2_reg=0.0,
+                learning_rate=0.001, log_steps=0, seed=11,
+                scale_lr_by_world=False, mesh_data=1, mesh_model=1,
+                steps_per_loop=1, transfer_ahead=0)
+    base.update(kw)
+    return Config(**base)
+
+
+def _timed_fit(cfg, batches, warmup=2):
+    """(ms_per_step, trainer, final_state): fit over ``warmup`` batches to
+    compile, then the timed fit reuses the cached step program."""
+    import jax
+    from deepfm_tpu.train import Trainer
+    tr = Trainer(cfg)
+    state = tr.init_state()
+    state, _ = tr.fit(state, batches[:warmup])
+    t0 = time.perf_counter()
+    state, summary = tr.fit(state, batches[warmup:])
+    jax.block_until_ready(state.params)
+    ms = (time.perf_counter() - t0) * 1000.0 / max(summary["steps"], 1)
+    return ms, tr, state
+
+
+def bench_sparse_vs_dense(quick):
+    import numpy as np
+    v, b, nb = 100_000, 1024, (8 if quick else 24)
+    batches = _synth_batches(nb + 2, b, 39, v)
+    out = {"V": v, "B": b, "steps": nb}
+    states = {}
+    for mode in ("dense", "sparse"):
+        ms, _, st = _timed_fit(
+            _cfg(feature_size=v, batch_size=b, embedding_update=mode),
+            batches)
+        out[f"{mode}_ms_per_step"] = round(ms, 3)
+        states[mode] = st
+    out["dense_over_sparse"] = round(
+        out["dense_ms_per_step"] / out["sparse_ms_per_step"], 2)
+    out["max_param_divergence"] = round(max(
+        float(np.abs(np.asarray(states["dense"].params[n], np.float32)
+                     - np.asarray(states["sparse"].params[n],
+                                  np.float32)).max())
+        for n in ("fm_w", "fm_v")), 6)
+    out["unique_ids_per_batch"] = round(_mean_unique(batches[2:]), 1)
+    return out
+
+
+def bench_scaling(quick):
+    # Physical rows capped by hashing: 4 tables x 262144 buckets = 1M rows
+    # regardless of the hashed vocab — feature_size can exceed any single
+    # allocation. Unique-ids-per-batch is what the step cost must track.
+    buckets = ",".join(["262144"] * 4)
+    b, nb = 1024, (6 if quick else 16)
+    rows = []
+    for v in (1_000_000, 10_000_000, 100_000_000):
+        batches = _synth_batches(nb + 2, b, 39, v)
+        ms, _, _ = _timed_fit(
+            _cfg(feature_size=v, batch_size=b, embedding_update="sparse",
+                 embedding_buckets=buckets), batches)
+        rows.append({"V": v, "B": b, "physical_rows": 4 * 262144,
+                     "sparse_ms_per_step": round(ms, 3),
+                     "unique_ids_per_batch":
+                         round(_mean_unique(batches[2:]), 1)})
+    # Same (largest) vocab, varying batch -> varying uniques: the cost
+    # driver, isolated from vocab.
+    for b2 in (256, 4096):
+        batches = _synth_batches(nb + 2, b2, 39, 100_000_000)
+        ms, _, _ = _timed_fit(
+            _cfg(feature_size=100_000_000, batch_size=b2,
+                 embedding_update="sparse", embedding_buckets=buckets),
+            batches)
+        rows.append({"V": 100_000_000, "B": b2,
+                     "physical_rows": 4 * 262144,
+                     "sparse_ms_per_step": round(ms, 3),
+                     "unique_ids_per_batch":
+                         round(_mean_unique(batches[2:]), 1)})
+    flat = (rows[2]["sparse_ms_per_step"]
+            / max(rows[0]["sparse_ms_per_step"], 1e-9))
+    return {"rows": rows,
+            "ms_ratio_100M_over_1M": round(flat, 2),
+            "cost_tracks_uniques_not_vocab": bool(flat < 3.0)}
+
+
+def bench_hot_cold(quick):
+    # One B=256 x F=39 group touches ~10k unique rows; 24k hot rows fit
+    # the depth-2 pinned lookahead (two groups) with room to evict.
+    v, b, nb = 200_000, 256, (10 if quick else 30)
+    hot = 24_576
+    batches = _synth_batches(nb, b, 39, v)
+    out = {"V": v, "B": b, "hot_rows": hot, "steps": nb,
+           "cold_dtype": "float32", "series": []}
+    for depth in (0, 2):
+        from deepfm_tpu.train import Trainer
+        cfg = _cfg(feature_size=v, batch_size=b, embedding_update="sparse",
+                   embedding_tiering="hot_cold", embedding_hot_rows=hot,
+                   transfer_ahead=depth)
+        tr = Trainer(cfg)
+        state = tr.init_state()
+        t0 = time.perf_counter()
+        state, summary = tr.fit(state, batches)
+        wall = time.perf_counter() - t0
+        st = tr._tier.stats
+        out["series"].append({
+            "transfer_ahead": depth,
+            "ms_per_step": round(wall * 1000 / max(summary["steps"], 1), 3),
+            "hit_rate": round(tr._tier.hit_rate(), 4),
+            "evictions": int(st["evictions"]),
+            "installs": int(st["installs"]),
+            "prefetch_fetch_s": round(st["prefetch_fetch_s"], 4),
+            "apply_fetch_s": round(st["apply_fetch_s"], 4),
+            "overlap_fraction": round(tr._tier.overlap_fraction(), 4),
+        })
+    out["overlap_at_depth2"] = out["series"][-1]["overlap_fraction"]
+    out["overlap_ok"] = bool(out["overlap_at_depth2"] >= 0.5)
+    return out
+
+
+def bench_sharded(devices):
+    """Legacy row-sharded lookup-strategy A/B (kept from the original
+    bench): masked_psum vs allgather_table under shard_map, timing plus
+    the analytic per-step collective bytes that decide the real-ICI
+    winner."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -32,73 +186,101 @@ def bench(v: int, k: int, b: int, f: int, m: int, data: int) -> None:
 
     from deepfm_tpu.ops import embedding as emb
 
-    devs = np.array(jax.devices()[:m * data]).reshape(data, m)
-    mesh = Mesh(devs, ("data", "model"))
-    vp = emb.padded_vocab(v, m)
-    table = jax.device_put(
-        np.random.default_rng(0).normal(size=(vp, k)).astype(np.float32),
-        jax.sharding.NamedSharding(mesh, P("model", None)))
-    ids = jax.device_put(
-        np.random.default_rng(1).integers(0, v, (b, f)).astype(np.int32),
-        jax.sharding.NamedSharding(mesh, P("data", None)))
+    results = []
+    for v, k, b, f, m, data in (
+            (117_581, 32, 1024, 39, 2, devices // 2),
+            (117_581, 32, 1024, 39, devices, 1),
+            (4_096, 32, 16_384, 39, devices, 1)):
+        devs = np.array(jax.devices()[:m * data]).reshape(data, m)
+        mesh = Mesh(devs, ("data", "model"))
+        vp = emb.padded_vocab(v, m)
+        table = jax.device_put(
+            np.random.default_rng(0).normal(size=(vp, k)).astype(np.float32),
+            jax.sharding.NamedSharding(mesh, P("model", None)))
+        ids = jax.device_put(
+            np.random.default_rng(1).integers(0, v, (b, f)).astype(np.int32),
+            jax.sharding.NamedSharding(mesh, P("data", None)))
 
-    def make(strategy):
-        def loss(tab, i):
-            e = emb.lookup(tab, i, axis_name="model", strategy=strategy)
-            return jnp.sum(e * e)
-        def step(tab, i):
-            l, g = jax.value_and_grad(loss)(tab, i)
-            # pmean over both axes: value-level no-op on already-replicated
-            # losses, but lets shard_map's VMA checker prove replication.
-            return jax.lax.pmean(jax.lax.pmean(l, "data"), "model"), g
-        return jax.jit(shard_map(
-            step, mesh=mesh,
-            in_specs=(P("model", None), P("data", None)),
-            out_specs=(P(), P("model", None))))
+        def make(strategy):
+            def loss(tab, i):
+                e = emb.lookup(tab, i, axis_name="model", strategy=strategy)
+                return jnp.sum(e * e)
 
-    rows = {}
-    for strategy in ("masked_psum", "allgather_table"):
-        fn = make(strategy)
-        l, g = fn(table, ids)  # compile
-        jax.block_until_ready(g)
-        best = float("inf")
-        for _ in range(3):
-            t0 = time.perf_counter()
-            for _ in range(5):
-                l, g = fn(table, ids)
+            def step(tab, i):
+                l, g = jax.value_and_grad(loss)(tab, i)
+                return jax.lax.pmean(
+                    jax.lax.pmean(l, "data"), "model"), g
+            return jax.jit(shard_map(
+                step, mesh=mesh,
+                in_specs=(P("model", None), P("data", None)),
+                out_specs=(P(), P("model", None))))
+
+        rows = {}
+        for strategy in ("masked_psum", "allgather_table"):
+            fn = make(strategy)
+            l, g = fn(table, ids)
             jax.block_until_ready(g)
-            best = min(best, (time.perf_counter() - t0) / 5)
-        rows[strategy] = best * 1000
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(5):
+                    l, g = fn(table, ids)
+                jax.block_until_ready(g)
+                best = min(best, (time.perf_counter() - t0) / 5)
+            rows[strategy] = best * 1000
 
-    # Analytic per-step collective traffic per device link (ring, fwd+bwd):
-    # masked_psum: psum([B/data, F, K]) fwd + nothing extra bwd (cotangent is
-    #   already local after masking) -> 2*(m-1)/m * B/data*F*K words.
-    # allgather_table: all_gather(V/m..V) fwd + reduce_scatter grad bwd
-    #   -> 2*(m-1)/m * V*K words.
-    act_words = (b // data) * f * k
-    psum_traffic = 2 * (m - 1) / m * act_words * 4
-    ag_traffic = 2 * (m - 1) / m * vp * k * 4
-    print(json.dumps({
-        "shape": {"V": v, "K": k, "B": b, "F": f,
-                  "mesh": f"{data}x{m}"},
-        "masked_psum_ms": round(rows["masked_psum"], 3),
-        "allgather_table_ms": round(rows["allgather_table"], 3),
-        "masked_psum_traffic_MB": round(psum_traffic / 1e6, 2),
-        "allgather_table_traffic_MB": round(ag_traffic / 1e6, 2),
-    }))
+        act_words = (b // data) * f * k
+        results.append({
+            "shape": {"V": v, "K": k, "B": b, "F": f, "mesh": f"{data}x{m}"},
+            "masked_psum_ms": round(rows["masked_psum"], 3),
+            "allgather_table_ms": round(rows["allgather_table"], 3),
+            "masked_psum_traffic_MB":
+                round(2 * (m - 1) / m * act_words * 4 / 1e6, 2),
+            "allgather_table_traffic_MB":
+                round(2 * (m - 1) / m * vp * k * 4 / 1e6, 2),
+        })
+    return results
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="small step counts (CI drill wrapper)")
+    ap.add_argument("--sharded", action="store_true",
+                    help="also run the legacy sharded lookup-strategy A/B "
+                         "on a virtual device mesh")
     ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--out", default=None,
+                    help="artifact path (default EMBED_r01.json at repo "
+                         "root; '-' to skip writing)")
     args = ap.parse_args()
-    _provision_virtual_devices(args.devices)
 
-    # Reference CTR shape: activations << table -> psum should win on ICI.
-    bench(v=117_581, k=32, b=1024, f=39, m=2, data=args.devices // 2)
-    bench(v=117_581, k=32, b=1024, f=39, m=args.devices, data=1)
-    # Small-table / huge-batch regime: table << activations -> all_gather.
-    bench(v=4_096, k=32, b=16_384, f=39, m=args.devices, data=1)
+    if args.sharded:
+        from __graft_entry__ import _provision_virtual_devices
+        _provision_virtual_devices(args.devices)
+
+    import jax
+    report = {
+        "bench": "embedding_scale",
+        "device_kind": jax.devices()[0].device_kind,
+        "load_kind": "synthetic-ctr",
+        "quick": bool(args.quick),
+        "sparse_vs_dense": bench_sparse_vs_dense(args.quick),
+        "scaling": bench_scaling(args.quick),
+        "hot_cold": bench_hot_cold(args.quick),
+    }
+    if args.sharded:
+        report["sharded_lookup_ab"] = bench_sharded(args.devices)
+
+    print(json.dumps(report, indent=1))
+    if args.out != "-":
+        out = args.out or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "EMBED_r01.json")
+        with open(out, "w") as f:
+            json.dump(report, f, indent=1)
+            f.write("\n")
+        print(f"wrote {out}", file=sys.stderr)
 
 
 if __name__ == "__main__":

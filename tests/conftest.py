@@ -61,6 +61,11 @@ def pytest_configure(config):
         "markers",
         "serving: serving-runtime tests (dynamic batcher, bucketed predict, "
         "hot swap, shared-memory frontend)")
+    config.addinivalue_line(
+        "markers",
+        "embedding: embedding-scale tests (sparse touched-row updates, "
+        "hash-bucketed multi-tables, hot/cold tiering); gated on the "
+        "backend's scatter-add path being run-to-run deterministic")
 
 
 # ---------------------------------------------------------------------------
@@ -91,6 +96,7 @@ def pytest_configure(config):
 _UNSET = object()
 _MESH_BITEXACT_REASON = _UNSET
 _MP_COLLECTIVES_REASON = _UNSET
+_EMBEDDING_REASON = _UNSET
 
 
 def _probe_mesh_bitexact():
@@ -175,6 +181,46 @@ def _probe_mp_collectives():
     return None
 
 
+def _probe_embedding_sparse():
+    """None if the sparse-update path (unique + scatter-add segment sums)
+    is run-to-run deterministic on this backend, else a skip reason. The
+    embedding suites assert bit-exact trajectories (touch-set exactness,
+    multi-step dispatch parity, tiered-vs-flat parity); a backend whose
+    scatter-add reassociates nondeterministically can't satisfy them."""
+    import numpy as np
+    from deepfm_tpu.config import Config
+    from deepfm_tpu.train import Trainer
+
+    def _run():
+        cfg = Config(
+            feature_size=200, field_size=4, embedding_size=4,
+            deep_layers="8", dropout="1.0", batch_size=32,
+            compute_dtype="float32", l2_reg=1e-4, learning_rate=0.01,
+            log_steps=0, seed=7, scale_lr_by_world=False,
+            mesh_data=1, mesh_model=1, steps_per_loop=1,
+            embedding_update="sparse")
+        rng = np.random.default_rng(5)
+        batches = [{
+            "label": rng.integers(0, 2, (32,)).astype(np.float32),
+            "feat_ids": rng.integers(0, 200, (32, 4)).astype(np.int32),
+            "feat_vals": rng.standard_normal((32, 4)).astype(np.float32),
+        } for _ in range(2)]
+        tr = Trainer(cfg)
+        state = tr.init_state()
+        state, _ = tr.fit(state, batches)
+        return state
+
+    s1, s2 = _run(), _run()
+    drift = max(
+        float(np.abs(np.asarray(s1.params[k]) - np.asarray(s2.params[k])).max())
+        for k in ("fm_w", "fm_v"))
+    if drift != 0.0:
+        return (
+            "environment: sparse embedding scatter-add is not run-to-run "
+            f"deterministic on this backend (2-step probe drift {drift:.2e})")
+    return None
+
+
 def _cached_reason(cache_name, probe):
     reason = globals()[cache_name]
     if reason is _UNSET:
@@ -190,6 +236,7 @@ def pytest_collection_modifyitems(config, items):
     probes = (
         ("mesh_bitexact", "_MESH_BITEXACT_REASON", _probe_mesh_bitexact),
         ("mp_collectives", "_MP_COLLECTIVES_REASON", _probe_mp_collectives),
+        ("embedding", "_EMBEDDING_REASON", _probe_embedding_sparse),
     )
     for marker_name, cache_name, probe in probes:
         gated = [it for it in items if marker_name in it.keywords]

@@ -1,0 +1,219 @@
+"""Hash-bucketed multi-table embeddings: stateless id -> (table, bucket)
+mapping, so the logical feature_size can exceed any single physical
+allocation.
+
+Determinism is the load-bearing property: the mapping is pure uint32
+arithmetic with pinned salts — no python hash(), no process state — so
+two processes (or a resumed job) place every id in the same bucket. The
+golden pins below freeze the exact mapping; a change to the mix constants
+silently reshuffles every checkpoint's rows and MUST fail here.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from deepfm_tpu.config import Config
+from deepfm_tpu.ops import embedding as emb_ops
+from deepfm_tpu.train import Trainer
+from deepfm_tpu.utils import checkpoint as ckpt_lib
+from deepfm_tpu.utils.retry import RetryPolicy
+
+pytestmark = pytest.mark.embedding
+
+V, B, F = 10_000, 32, 6
+BUCKETS = "97,131,61"
+
+
+def _cfg(**kw):
+    base = dict(
+        feature_size=V, field_size=F, embedding_size=8,
+        deep_layers="16,8", dropout="1.0,1.0", batch_size=B,
+        compute_dtype="float32", l2_reg=1e-4, learning_rate=1e-3,
+        log_steps=0, seed=11, scale_lr_by_world=False,
+        mesh_data=1, mesh_model=1, steps_per_loop=1,
+        embedding_buckets=BUCKETS)
+    base.update(kw)
+    return Config(**base)
+
+
+def _batches(nb, seed=3):
+    rng = np.random.default_rng(seed)
+    return [dict(
+        feat_ids=rng.integers(0, V, size=(B, F)).astype(np.int32),
+        feat_vals=rng.normal(size=(B, F)).astype(np.float32),
+        label=rng.integers(0, 2, size=(B,)).astype(np.float32))
+        for _ in range(nb)]
+
+
+class TestGoldenPins:
+    """Frozen hash values: these change ONLY if the mixing constants or
+    salt scheme change, which invalidates every hashed checkpoint."""
+
+    IDS = [0, 1, 2, 12345, 999_999_937]
+
+    def test_bucket_pins(self):
+        import jax.numpy as jnp
+        ids = jnp.asarray(self.IDS, dtype=jnp.int32)
+        assert np.asarray(
+            emb_ops.hash_bucket(ids, 1000, salt=1)).tolist() == \
+            [27, 0, 660, 728, 564]
+        assert np.asarray(
+            emb_ops.hash_bucket(ids, 1000, salt=2)).tolist() == \
+            [926, 660, 0, 112, 169]
+
+    def test_table_assign_pins(self):
+        import jax.numpy as jnp
+        ids = jnp.asarray(self.IDS, dtype=jnp.int32)
+        assert np.asarray(
+            emb_ops.hash_table_assign(ids, 4)).tolist() == [1, 1, 0, 2, 0]
+
+    def test_cross_process_determinism(self):
+        """A fresh interpreter computes the identical mapping (no process
+        state, no PYTHONHASHSEED dependence)."""
+        prog = (
+            "import json, numpy as np\n"
+            "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            "import jax.numpy as jnp\n"
+            "from deepfm_tpu.ops import embedding as emb\n"
+            f"ids = jnp.asarray({self.IDS!r}, dtype=jnp.int32)\n"
+            "print(json.dumps({\n"
+            "  'b1': np.asarray(emb.hash_bucket(ids, 1000, salt=1)).tolist(),\n"
+            "  'a4': np.asarray(emb.hash_table_assign(ids, 4)).tolist()}))\n")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONHASHSEED="99",
+                   PYTHONPATH=os.path.dirname(os.path.dirname(
+                       os.path.abspath(__file__))))
+        env.pop("XLA_FLAGS", None)
+        out = subprocess.run(
+            [sys.executable, "-c", prog], env=env, capture_output=True,
+            text=True, timeout=240)
+        assert out.returncode == 0, out.stderr[-800:]
+        got = json.loads(out.stdout.strip().splitlines()[-1])
+        assert got["b1"] == [27, 0, 660, 728, 564]
+        assert got["a4"] == [1, 1, 0, 2, 0]
+
+
+class TestLayout:
+    def test_physical_rows_capped_below_vocab(self):
+        tr = Trainer(_cfg())
+        emb = tr.model.emb
+        assert emb.hashed
+        assert emb.num_physical_rows() == 97 + 131 + 61
+        assert emb.num_physical_rows() < V
+        state = tr.init_state()
+        assert set(state.params["fm_v"]) == {"t0", "t1", "t2"}
+        assert state.params["fm_v"]["t1"].shape == (131, 8)
+
+    def test_lookup_matches_manual_gather(self):
+        import jax.numpy as jnp
+        tr = Trainer(_cfg())
+        state = tr.init_state()
+        emb = tr.model.emb
+        ids = jnp.asarray(_batches(1)[0]["feat_ids"])
+        got = np.asarray(emb.lookup(state.params["fm_v"], ids))
+        assign = np.asarray(emb_ops.hash_table_assign(ids, 3))
+        want = np.zeros_like(got)
+        for i, b in enumerate((97, 131, 61)):
+            bucket = np.asarray(emb_ops.hash_bucket(ids, b, salt=i + 1))
+            rows = np.asarray(state.params["fm_v"][f"t{i}"])[bucket]
+            want += rows * (assign == i)[..., None]
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+    def test_field_assign_routes_by_position(self):
+        import jax.numpy as jnp
+        tr = Trainer(_cfg(embedding_assign="field"))
+        state = tr.init_state()
+        emb = tr.model.emb
+        ids = jnp.asarray(_batches(1)[0]["feat_ids"])
+        got = np.asarray(emb.lookup(state.params["fm_v"], ids))
+        want = np.zeros_like(got)
+        for f in range(F):
+            i = f % 3
+            b = (97, 131, 61)[i]
+            bucket = np.asarray(
+                emb_ops.hash_bucket(ids[:, f], b, salt=i + 1))
+            want[:, f] = np.asarray(
+                state.params["fm_v"][f"t{i}"])[bucket]
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+
+class TestTraining:
+    def test_dense_training_deterministic(self):
+        batches = _batches(4)
+
+        def run():
+            tr = Trainer(_cfg())
+            state = tr.init_state()
+            state, _ = tr.fit(state, batches)
+            return state
+
+        s1, s2 = run(), run()
+        for k in ("t0", "t1", "t2"):
+            np.testing.assert_array_equal(
+                np.asarray(s1.params["fm_v"][k]),
+                np.asarray(s2.params["fm_v"][k]))
+
+    def test_hashed_sparse_combo_trains(self):
+        cfg = _cfg(embedding_update="sparse")
+        tr = Trainer(cfg)
+        state = tr.init_state()
+        before = {k: np.asarray(v) for k, v in state.params["fm_v"].items()}
+        state, summary = tr.fit(state, _batches(8))
+        assert summary["steps"] == 8
+        assert np.isfinite(summary["loss"])
+        assert any(not np.array_equal(before[k],
+                                      np.asarray(state.params["fm_v"][k]))
+                   for k in before)
+        ev = tr.evaluate(state, _batches(4, seed=9))
+        assert np.isfinite(ev["loss"])
+
+    def test_checkpoint_resume_continues_identically(self, tmp_path):
+        """fit(2) -> save -> restore into a fresh Trainer -> fit(2 more)
+        must equal fit(4) straight through, bit-for-bit (the sparse opt
+        state — m/v/tau and the global count — round-trips)."""
+        batches = _batches(4, seed=7)
+        cfg = _cfg(embedding_update="sparse")
+
+        tr = Trainer(cfg)
+        s_cont = tr.init_state()
+        s_cont, _ = tr.fit(s_cont, batches)
+
+        tr1 = Trainer(cfg)
+        s1 = tr1.init_state()
+        s1, _ = tr1.fit(s1, batches[:2])
+        mgr = ckpt_lib.CheckpointManager(
+            str(tmp_path / "c"), async_save=False,
+            retry_policy=RetryPolicy(base_delay=0.0, max_delay=0.0))
+        mgr.save(2, s1, force=True)
+
+        tr2 = Trainer(cfg)
+        s2 = mgr.restore(tr2.init_state())
+        s2, _ = tr2.fit(s2, batches[2:])
+
+        assert int(s2.opt_state["count"]) == int(s_cont.opt_state["count"])
+        for k in ("t0", "t1", "t2"):
+            np.testing.assert_array_equal(
+                np.asarray(s_cont.params["fm_v"][k]),
+                np.asarray(s2.params["fm_v"][k]))
+            oe_a = s_cont.opt_state["embed"]["fm_v"][k]
+            oe_b = s2.opt_state["embed"]["fm_v"][k]
+            np.testing.assert_array_equal(np.asarray(oe_a.m),
+                                          np.asarray(oe_b.m))
+            np.testing.assert_array_equal(np.asarray(oe_a.tau),
+                                          np.asarray(oe_b.tau))
+
+
+class TestValidation:
+    def test_tiering_rejects_hashed_layout(self):
+        with pytest.raises(ValueError, match="monolithic"):
+            _cfg(embedding_update="sparse", embedding_tiering="hot_cold",
+                 embedding_hot_rows=64)
+
+    def test_bad_bucket_list(self):
+        with pytest.raises(ValueError, match="embedding_buckets"):
+            _cfg(embedding_buckets="97,-3")

@@ -1,0 +1,222 @@
+"""Sparse (touched-rows-only) embedding updates vs the dense reference.
+
+The contract under test (ISSUE: beyond-HBM embedding scale):
+
+* exact-touch-set: a sparse step changes ONLY the rows the batch touched —
+  untouched rows are bit-identical, which is the property that lets step
+  cost scale with unique-ids-per-batch instead of vocab;
+* the lazy/timestamped Adam moments telescope to exactly what dense Adam
+  computes for a row under its zero idle gradients;
+* the full trajectory matches dense within a pinned tolerance (NOT
+  bit-exact — dense Adam moves idle rows by their decaying momentum tail,
+  sparse deliberately does not; optimizers.py quantifies the bound);
+* padded_vocab pad rows never move (L2 + gradients structurally masked).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from deepfm_tpu.config import Config
+from deepfm_tpu.train import Trainer
+from deepfm_tpu.train import optimizers as opt_lib
+
+pytestmark = pytest.mark.embedding
+
+V, B, F = 500, 32, 6
+
+
+def _cfg(**kw):
+    base = dict(
+        feature_size=V, field_size=F, embedding_size=8,
+        deep_layers="16,8", dropout="1.0,1.0", batch_size=B,
+        compute_dtype="float32", l2_reg=1e-4, learning_rate=1e-3,
+        log_steps=0, seed=11, scale_lr_by_world=False,
+        mesh_data=1, mesh_model=1, steps_per_loop=1)
+    base.update(kw)
+    return Config(**base)
+
+
+def _batches(nb, seed=3, v=V, b=B):
+    rng = np.random.default_rng(seed)
+    return [dict(
+        feat_ids=rng.integers(0, v, size=(b, F)).astype(np.int32),
+        feat_vals=rng.normal(size=(b, F)).astype(np.float32),
+        label=rng.integers(0, 2, size=(b,)).astype(np.float32))
+        for _ in range(nb)]
+
+
+def _fit(cfg, batches):
+    tr = Trainer(cfg)
+    state = tr.init_state()
+    state, summary = tr.fit(state, batches)
+    return tr, state, summary
+
+
+class TestLazyAdamMath:
+    def test_telescoped_moments_match_dense_recursion(self):
+        """Lazy m/v at a touch == dense Adam's m/v after k zero-gradient
+        idle steps, for an arbitrary touch pattern."""
+        rng = np.random.default_rng(0)
+        b1, b2, lr = 0.9, 0.999, 0.01
+        steps = 60
+        touched = rng.random(steps) < 0.3
+        touched[0] = True
+        grads = rng.standard_normal(steps).astype(np.float32)
+        # Dense reference: g=0 on idle steps, moments decay every step.
+        m_d, v_d = 0.0, 0.0
+        m_l = np.zeros((1, 1), np.float32)
+        v_l = np.zeros((1, 1), np.float32)
+        tau = np.zeros((1,), np.int32)
+        w = np.ones((1, 1), np.float32)
+        for t in range(1, steps + 1):
+            g = grads[t - 1] if touched[t - 1] else 0.0
+            m_d = b1 * m_d + (1 - b1) * g
+            v_d = b2 * v_d + (1 - b2) * g * g
+            if touched[t - 1]:
+                w_new, m_new, v_new = opt_lib.sparse_adam_rows(
+                    w, np.full((1, 1), grads[t - 1], np.float32),
+                    m_l, v_l, tau, np.int32(t), lr=lr)
+                m_l, v_l = np.asarray(m_new), np.asarray(v_new)
+                tau = np.full((1,), t, np.int32)
+                w = np.asarray(w_new)
+                np.testing.assert_allclose(m_l[0, 0], m_d, rtol=1e-5,
+                                           atol=1e-7)
+                np.testing.assert_allclose(v_l[0, 0], v_d, rtol=1e-5,
+                                           atol=1e-7)
+
+    def test_every_step_touch_matches_optax_adam(self):
+        """With a touch every step the lazy path degenerates to plain
+        Adam — compare one row against optax over 10 steps."""
+        import optax
+        rng = np.random.default_rng(1)
+        lr = 0.01
+        grads = rng.standard_normal((10, 4)).astype(np.float32)
+        tx = optax.adam(lr, b1=0.9, b2=0.999, eps=1e-8)
+        w_ref = np.zeros((4,), np.float32)
+        opt = tx.init(w_ref)
+        w = np.zeros((1, 4), np.float32)
+        m = np.zeros((1, 4), np.float32)
+        v = np.zeros((1, 4), np.float32)
+        tau = np.zeros((1,), np.int32)
+        for t in range(1, 11):
+            up, opt = tx.update(grads[t - 1], opt, w_ref)
+            w_ref = w_ref + np.asarray(up)
+            w_new, m_new, v_new = opt_lib.sparse_adam_rows(
+                w, grads[t - 1:t], m, v, tau, np.int32(t), lr=lr)
+            w, m, v = map(np.asarray, (w_new, m_new, v_new))
+            tau = np.full((1,), t, np.int32)
+        np.testing.assert_allclose(w[0], w_ref, rtol=1e-5, atol=1e-7)
+
+
+class TestTouchSet:
+    def test_untouched_rows_bit_identical(self):
+        """One sparse step: rows outside the batch's id set must not move
+        by even one bit; touched rows must move."""
+        cfg = _cfg(embedding_update="sparse")
+        tr = Trainer(cfg)
+        state = tr.init_state()
+        w0 = {n: np.asarray(state.params[n]) for n in ("fm_w", "fm_v")}
+        batch = _batches(1, seed=5)[0]
+        state, _ = tr.fit(state, [batch])
+        touched = np.unique(batch["feat_ids"])
+        untouched = np.setdiff1d(np.arange(V), touched)
+        for n in ("fm_w", "fm_v"):
+            w1 = np.asarray(state.params[n])
+            np.testing.assert_array_equal(w1[untouched], w0[n][untouched])
+            assert not np.array_equal(w1[touched], w0[n][touched])
+
+    def test_opt_state_counts_and_tau(self):
+        cfg = _cfg(embedding_update="sparse")
+        tr = Trainer(cfg)
+        state = tr.init_state()
+        batch = _batches(1, seed=5)[0]
+        state, _ = tr.fit(state, [batch])
+        opt = state.opt_state
+        assert int(opt["count"]) == 1
+        touched = np.unique(batch["feat_ids"])
+        tau = np.asarray(opt["embed"]["fm_w"]["table"].tau)
+        assert (tau[touched] == 1).all()
+        untouched = np.setdiff1d(np.arange(V), touched)
+        assert (tau[untouched] == 0).all()
+
+
+class TestTrajectoryParity:
+    def test_sparse_matches_dense_within_pinned_tolerance(self):
+        """20 steps at lr=1e-3, l2 on: the only divergence source is the
+        documented idle-row momentum tail (and the touched-rows-only L2).
+        Measured max diff ~0.018 on the embedding tables; pinned at 0.05
+        (and 0.03 on the shared tower, measured ~0.005)."""
+        batches = _batches(20)
+        _, sd, _ = _fit(_cfg(embedding_update="dense"), batches)
+        _, ss, _ = _fit(_cfg(embedding_update="sparse"), batches)
+        for n in ("fm_w", "fm_v"):
+            d = np.abs(np.asarray(sd.params[n], np.float32)
+                       - np.asarray(ss.params[n], np.float32)).max()
+            assert d < 0.05, (n, d)
+        tower = max(
+            float(np.abs(np.asarray(a, np.float32)
+                         - np.asarray(b, np.float32)).max())
+            for a, b in zip(jax.tree.leaves(sd.params["tower"]),
+                            jax.tree.leaves(ss.params["tower"])))
+        assert tower < 0.03, tower
+
+    def test_multi_step_dispatch_bit_identical(self):
+        """steps_per_loop=4 (scanned dispatch) must reproduce the
+        steps_per_loop=1 sparse trajectory bit-for-bit."""
+        batches = _batches(8)
+        _, s1, _ = _fit(_cfg(embedding_update="sparse"), batches)
+        _, s4, _ = _fit(_cfg(embedding_update="sparse", steps_per_loop=4),
+                        batches)
+        for n in ("fm_w", "fm_v"):
+            np.testing.assert_array_equal(np.asarray(s1.params[n]),
+                                          np.asarray(s4.params[n]))
+
+    def test_eval_runs_in_sparse_mode(self):
+        batches = _batches(6)
+        tr, state, _ = _fit(_cfg(embedding_update="sparse"), batches)
+        ev = tr.evaluate(state, _batches(4, seed=9))
+        assert np.isfinite(ev["loss"])
+
+
+class TestPadRows:
+    """padded_vocab pad rows (mesh_model row-sharding rounds the vocab up)
+    must stay bit-zero under training: L2 and gradients are structurally
+    masked, so neither adam nor ftrl can move them."""
+
+    @pytest.mark.parametrize("optimizer", ["adam", "ftrl"])
+    def test_pad_rows_stay_bit_zero(self, optimizer):
+        cfg = _cfg(mesh_data=1, mesh_model=8, optimizer=optimizer,
+                   l2_reg=1e-3, learning_rate=0.01)
+        tr = Trainer(cfg)
+        state = tr.init_state()
+        pv = tr.model.padded_vocab
+        assert pv > V, "test requires actual pad rows"
+        batches = [dict(b, label=b["label"][:, None]) for b in _batches(4)]
+        state, _ = tr.fit(state, batches)
+        for n in ("fm_w", "fm_v"):
+            w = np.asarray(state.params[n], np.float32)
+            assert w.shape[0] == pv
+            np.testing.assert_array_equal(
+                w[V:], np.zeros_like(w[V:]),
+                err_msg=f"{optimizer}: pad rows of {n} moved")
+
+
+class TestOtherModels:
+    @pytest.mark.parametrize("model", ["widedeep", "dcnv2"])
+    def test_sparse_smoke(self, model):
+        cfg = _cfg(embedding_update="sparse", model=model)
+        tr, state, summary = _fit(cfg, _batches(4))
+        assert summary["steps"] == 4
+        assert np.isfinite(summary["loss"])
+
+
+class TestGating:
+    def test_sparse_requires_adam(self):
+        with pytest.raises(ValueError, match="lazy"):
+            _cfg(embedding_update="sparse", optimizer="ftrl")
+
+    def test_mesh_falls_back_to_dense(self):
+        cfg = _cfg(embedding_update="sparse", mesh_data=8)
+        tr = Trainer(cfg)
+        assert tr.sparse_embed is False

@@ -1,0 +1,193 @@
+"""Hot/cold tiered embedding storage: correctness of the cache protocol.
+
+The key claim is that tiering is INVISIBLE to the optimizer: with float32
+cold storage, a tiered run's densified tables must be bit-identical to
+the same run with the whole table device-resident (sparse mode) — the
+evict/write-back/late-fetch/install machinery changes where rows live,
+never their values. Fault healing must preserve that bit-exactness too.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from deepfm_tpu.config import Config
+from deepfm_tpu.data.hot_cold import ColdStore
+from deepfm_tpu.train import Trainer
+from deepfm_tpu.utils import faults
+
+pytestmark = pytest.mark.embedding
+
+V, B, F, NB = 500, 32, 6, 12
+HOT = 256
+
+
+def _cfg(**kw):
+    base = dict(
+        feature_size=V, field_size=F, embedding_size=8,
+        deep_layers="16,8", dropout="1.0,1.0", batch_size=B,
+        compute_dtype="float32", l2_reg=1e-4, learning_rate=1e-3,
+        log_steps=0, seed=11, scale_lr_by_world=False,
+        mesh_data=1, mesh_model=1, steps_per_loop=1,
+        embedding_update="sparse")
+    base.update(kw)
+    return Config(**base)
+
+
+def _batches(nb=NB, seed=3):
+    rng = np.random.default_rng(seed)
+    return [dict(
+        feat_ids=rng.integers(0, V, size=(B, F)).astype(np.int32),
+        feat_vals=rng.normal(size=(B, F)).astype(np.float32),
+        label=rng.integers(0, 2, size=(B,)).astype(np.float32))
+        for _ in range(nb)]
+
+
+def _run(cfg, batches=None):
+    tr = Trainer(cfg)
+    state = tr.init_state()
+    state, _ = tr.fit(state, batches if batches is not None else _batches())
+    return tr, state
+
+
+class TestColdStore:
+    def test_float32_roundtrip_exact(self):
+        rng = np.random.default_rng(0)
+        a = rng.standard_normal((40, 8)).astype(np.float32)
+        cs = ColdStore(a, "float32")
+        np.testing.assert_array_equal(cs.fetch(np.arange(10, 20)), a[10:20])
+        new = rng.standard_normal((5, 8)).astype(np.float32)
+        cs.write(np.arange(5), new)
+        np.testing.assert_array_equal(cs.fetch(np.arange(5)), new)
+        np.testing.assert_array_equal(cs.dense()[20:], a[20:])
+
+    def test_int8_roundtrip_within_quant_error(self):
+        rng = np.random.default_rng(1)
+        a = rng.standard_normal((40, 8)).astype(np.float32)
+        cs = ColdStore(a, "int8")
+        got = cs.fetch(np.arange(40))
+        # Per-row symmetric quant: error bounded by scale/2 = max|row|/254.
+        bound = (np.abs(a).max(axis=1, keepdims=True) / 254.0) + 1e-7
+        assert (np.abs(got - a) <= bound).all()
+        assert cs.nbytes() < a.nbytes / 2
+
+    def test_int8_halves_weight_bytes(self):
+        a = np.ones((1000, 8), np.float32)
+        assert ColdStore(a, "int8").nbytes() <= a.nbytes / 2 + 4 * 1000
+
+
+@pytest.fixture(scope="module")
+def sparse_ref():
+    """Plain (untiered) sparse run — the bit-exactness reference."""
+    return _run(_cfg())
+
+
+@pytest.fixture(scope="module")
+def tiered_run():
+    return _run(_cfg(embedding_tiering="hot_cold", embedding_hot_rows=HOT,
+                     transfer_ahead=2))
+
+
+class TestTieredParity:
+    def test_densified_bit_identical_to_sparse(self, sparse_ref, tiered_run):
+        _, s_ref = sparse_ref
+        tr, s_t = tiered_run
+        dense = tr._tier.densified(s_t)
+        for n in ("fm_w", "fm_v"):
+            np.testing.assert_array_equal(
+                np.asarray(s_ref.params[n], np.float32),
+                np.asarray(dense.params[n], np.float32))
+
+    def test_evictions_actually_exercised(self, tiered_run):
+        tr, _ = tiered_run
+        st = tr._tier.stats
+        assert st["plans"] == NB
+        assert st["evictions"] > 0, "HOT too large: protocol not exercised"
+        assert st["installs"] >= st["evictions"]
+        assert 0.0 < tr._tier.hit_rate() < 1.0
+
+    def test_eval_matches_untiered(self, sparse_ref, tiered_run):
+        tr_ref, s_ref = sparse_ref
+        tr, s_t = tiered_run
+        ev_ref = tr_ref.evaluate(s_ref, _batches(4, seed=9))
+        ev_t = tr.evaluate(s_t, _batches(4, seed=9))
+        assert abs(ev_ref["loss"] - ev_t["loss"]) < 1e-6
+
+    def test_int8_cold_within_tolerance(self, sparse_ref):
+        _, s_ref = sparse_ref
+        tr, s_q = _run(_cfg(embedding_tiering="hot_cold",
+                            embedding_hot_rows=HOT, transfer_ahead=2,
+                            embedding_cold_dtype="int8"))
+        dense = tr._tier.densified(s_q)
+        for n in ("fm_w", "fm_v"):
+            d = np.abs(np.asarray(s_ref.params[n], np.float32)
+                       - np.asarray(dense.params[n], np.float32)).max()
+            assert d < 5e-2, (n, d)
+
+
+class TestFaults:
+    @pytest.mark.faults
+    def test_cold_fetch_faults_heal_bit_exact(self, tiered_run):
+        """Two injected cold-fetch failures: the runtime retries, and the
+        healed run's tables are bit-identical to the unfaulted one."""
+        tr_ref, s_ref = tiered_run
+        faults.set_cold_fetch_plan(2)
+        try:
+            tr, s_f = _run(_cfg(embedding_tiering="hot_cold",
+                                embedding_hot_rows=HOT, transfer_ahead=2))
+        finally:
+            faults.set_cold_fetch_plan(0)
+        assert tr._tier.stats["fetch_retries"] == 2
+        ref_dense = tr_ref._tier.densified(s_ref)
+        got_dense = tr._tier.densified(s_f)
+        for n in ("fm_w", "fm_v"):
+            np.testing.assert_array_equal(
+                np.asarray(ref_dense.params[n]),
+                np.asarray(got_dense.params[n]))
+
+
+class TestCapacity:
+    def test_too_small_cache_raises(self):
+        cfg = _cfg(embedding_tiering="hot_cold", embedding_hot_rows=16,
+                   transfer_ahead=0)
+        tr = Trainer(cfg)
+        state = tr.init_state()
+        with pytest.raises(RuntimeError, match="hot cache too small"):
+            tr.fit(state, _batches(2))
+
+    def test_config_rejects_tiering_without_sparse(self):
+        with pytest.raises(ValueError, match="sparse"):
+            _cfg(embedding_update="dense", embedding_tiering="hot_cold",
+                 embedding_hot_rows=HOT)
+
+    def test_config_rejects_hot_rows_out_of_range(self):
+        with pytest.raises(ValueError, match="embedding_hot_rows"):
+            _cfg(embedding_tiering="hot_cold", embedding_hot_rows=0)
+        with pytest.raises(ValueError, match="embedding_hot_rows"):
+            _cfg(embedding_tiering="hot_cold", embedding_hot_rows=V)
+
+
+@pytest.mark.slow
+class TestBenchDrill:
+    def test_bench_embedding_quick(self, tmp_path):
+        """The CI drill: scripts/bench_embedding.py --quick must produce
+        an artifact whose acceptance booleans hold (sparse cost tracks
+        uniques not vocab; prefetch overlaps >= 50% of cold-fetch time)."""
+        out = str(tmp_path / "EMBED.json")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(root, "scripts",
+                                          "bench_embedding.py"),
+             "--quick", "--out", out],
+            env=env, capture_output=True, text=True, timeout=540)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        report = json.load(open(out))
+        assert report["load_kind"] == "synthetic-ctr"
+        assert report["scaling"]["cost_tracks_uniques_not_vocab"] is True
+        assert report["hot_cold"]["overlap_ok"] is True
