@@ -526,6 +526,86 @@ def serving_series() -> dict:
     }
 
 
+def multitask_series() -> dict:
+    """Multi-task head comparison: per-task AUC + train ex/s for a
+    single-task baseline vs shared_bottom vs MMoE over the SAME data,
+    shared-bottom capacity, optimizer, and step budget.
+
+    Honesty fields: the data is synthetic two-label CTR/CVR (click-gated
+    conversions over hidden linear weights, ``libsvm.generate_synthetic_ctr
+    num_labels=2``), so the AUC DELTAS between variants are the meaningful
+    signal, not the absolute values; ex/s times the full ``Trainer.fit``
+    loop over pre-decoded in-memory batches (no disk decode in the window,
+    but host->device transfer included) — it is a relative head-cost
+    series, not the headline throughput anchor."""
+    import glob as glob_mod
+    import tempfile
+
+    import jax
+
+    from deepfm_tpu.config import Config
+    from deepfm_tpu.data import libsvm
+    from deepfm_tpu.data.pipeline import CtrPipeline
+    from deepfm_tpu.train import Trainer
+
+    fs, fields, bs = 20000, 13, 512
+    out = {
+        "data_kind": "synthetic-two-label",
+        "device_kind": jax.devices()[0].device_kind,
+    }
+    with tempfile.TemporaryDirectory() as d:
+        libsvm.generate_synthetic_ctr(
+            d, num_files=2, examples_per_file=8192, feature_size=fs,
+            field_size=fields, prefix="tr", seed=0, num_labels=2)
+        libsvm.generate_synthetic_ctr(
+            d, num_files=1, examples_per_file=8192, feature_size=fs,
+            field_size=fields, prefix="va", seed=1, num_labels=2)
+        tr_files = sorted(glob_mod.glob(os.path.join(d, "tr*.tfrecords")))
+        va_files = sorted(glob_mod.glob(os.path.join(d, "va*.tfrecords")))
+
+        def batches(files, shuffle, epochs=1):
+            return list(CtrPipeline(
+                files, field_size=fields, batch_size=bs, num_epochs=epochs,
+                shuffle=shuffle, shuffle_files=shuffle, seed=0,
+                drop_remainder=True, prefetch_batches=0, num_labels=2))
+
+        train_b = batches(tr_files, shuffle=True, epochs=2)
+        val_b = batches(va_files, shuffle=False)
+
+        def run(**kw):
+            cfg = Config(
+                feature_size=fs, field_size=fields, embedding_size=16,
+                deep_layers="64,32", dropout="1.0,1.0", batch_size=bs,
+                learning_rate=1e-3, optimizer="Adam", l2_reg=1e-5,
+                compute_dtype="float32", log_steps=0, seed=0,
+                scale_lr_by_world=False, **kw)
+            trainer = Trainer(cfg)
+            state = trainer.init_state()
+            state, _ = trainer.fit(state, train_b[:2])  # compile warmup
+            t0 = time.perf_counter()
+            state, m = trainer.fit(state, train_b)
+            dt = time.perf_counter() - t0
+            ev = trainer.evaluate(state, val_b)
+            entry = {
+                "ex_per_s": round(int(m["steps"]) * bs / dt, 1),
+                "auc_ctr": round(float(ev.get("auc_ctr", ev["auc"])), 4),
+            }
+            if "auc_cvr" in ev:
+                entry["auc_cvr"] = round(float(ev["auc_cvr"]), 4)
+            return entry
+
+        out["single_task_baseline"] = run()
+        out["shared_bottom"] = run(tasks="ctr,cvr",
+                                   multitask="shared_bottom")
+        out["mmoe"] = run(tasks="ctr,cvr", multitask="mmoe",
+                          mmoe_experts=4)
+        base = out["single_task_baseline"]["ex_per_s"]
+        for key in ("shared_bottom", "mmoe"):
+            out[key]["ex_per_s_vs_single_task"] = round(
+                out[key]["ex_per_s"] / max(base, 1e-9), 3)
+    return out
+
+
 def pallas_ab_device_ratio() -> dict:
     """Interleaved Pallas-vs-XLA A/B over the device-only staged multi-step
     (no transfer inside the timed window) — the regression canary for the
@@ -725,6 +805,12 @@ def main() -> None:
         print(f"bench: serving series error: {e}", file=sys.stderr)
         serving = {"error": str(e)}
 
+    try:
+        multitask = multitask_series()
+    except Exception as e:
+        print(f"bench: multitask series error: {e}", file=sys.stderr)
+        multitask = {"error": str(e)}
+
     nominal_per_accel_baseline = 250_000.0 / 4.0
     # MFU from the device-only series (no transfer in the window): model
     # FLOPs/example x device-only examples/sec/chip over the chip's dense
@@ -762,6 +848,7 @@ def main() -> None:
         "device_resident": device_resident,
         "online_publish": online_publish,
         "serving": serving,
+        "multitask": multitask,
         "pallas_smoke": pallas_smoke,
     }
     if scaling is not None:

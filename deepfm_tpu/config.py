@@ -62,6 +62,22 @@ class Config:
     l2_reg: float = 1e-4
     loss_type: str = "log_loss"       # log_loss | square_loss
 
+    # ---- multi-task ranking (README "Multi-task ranking", TUNING §2.12) ----
+    # Comma list of task names. One name = the single-task zoo (--model
+    # picks the graph); two names (e.g. "ctr,cvr") build the multi-task
+    # model: task 0 reads the batch's `label` column, task 1 the optional
+    # `label2` column.
+    tasks: str = "ctr"
+    # Per-task loss weights as a comma list ("" = all 1.0). Same length as
+    # --tasks when set.
+    task_weights: str = ""
+    # Multi-task architecture: shared_bottom (one shared hidden stack,
+    # per-task heads), mmoe (mixture-of-experts with per-task softmax
+    # gates; Ma et al., KDD 2018), esmm (entire-space CTR+CVR; Ma et al.,
+    # SIGIR 2018 — requires exactly the 2-task contract).
+    multitask: str = "shared_bottom"  # shared_bottom | mmoe | esmm
+    mmoe_experts: int = 4             # expert count for --multitask mmoe
+
     # ---- optimization ----
     optimizer: str = "Adam"           # Adam | Adagrad | Momentum | ftrl
     learning_rate: float = 5e-4
@@ -248,8 +264,36 @@ class Config:
     def validate(self) -> None:
         if self.task_type not in ("train", "eval", "infer", "export"):
             raise ValueError(f"unknown task_type: {self.task_type!r}")
-        if self.model not in ("deepfm", "widedeep", "dcnv2"):
+        if self.model not in ("deepfm", "widedeep", "dcnv2", "dlrm"):
             raise ValueError(f"unknown model: {self.model!r}")
+        names = self.task_names
+        if not names:
+            raise ValueError("tasks must name at least one task")
+        if len(names) != len(set(names)):
+            raise ValueError(f"task names must be unique, got {self.tasks!r}")
+        if len(names) > 2:
+            raise ValueError(
+                "at most 2 tasks are supported (the input contract carries "
+                f"label + label2), got {self.tasks!r}")
+        if self.multitask not in ("shared_bottom", "mmoe", "esmm"):
+            raise ValueError(
+                f"multitask must be shared_bottom|mmoe|esmm, got "
+                f"{self.multitask!r}")
+        if self.mmoe_experts < 1:
+            raise ValueError("mmoe_experts must be >= 1")
+        try:
+            weights = self.task_weight_values
+        except ValueError as exc:
+            raise ValueError(
+                f"task_weights must be a comma list of floats, got "
+                f"{self.task_weights!r}") from exc
+        if len(weights) != len(names):
+            raise ValueError(
+                f"task_weights has {len(weights)} entries for "
+                f"{len(names)} tasks ({self.tasks!r})")
+        if any(w < 0 for w in weights):
+            raise ValueError(
+                f"task_weights must be >= 0, got {self.task_weights!r}")
         if self.optimizer.lower() not in ("adam", "adagrad", "momentum", "ftrl", "sgd"):
             raise ValueError(f"unknown optimizer: {self.optimizer!r}")
         if self.loss_type not in ("log_loss", "square_loss"):
@@ -419,6 +463,21 @@ class Config:
     @property
     def dropout_rates(self) -> List[float]:
         return [float(x) for x in self.dropout.split(",") if x.strip()]
+
+    @property
+    def task_names(self) -> List[str]:
+        return [t.strip() for t in self.tasks.split(",") if t.strip()]
+
+    @property
+    def num_tasks(self) -> int:
+        return len(self.task_names)
+
+    @property
+    def task_weight_values(self) -> List[float]:
+        vals = [float(x) for x in self.task_weights.split(",") if x.strip()]
+        if not vals:
+            return [1.0] * self.num_tasks
+        return vals
 
     @property
     def serve_bucket_sizes(self) -> List[int]:

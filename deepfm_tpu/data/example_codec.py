@@ -24,7 +24,7 @@ Wire format facts used (protobuf encoding spec):
 from __future__ import annotations
 
 import struct
-from typing import Dict, List, Tuple, Union
+from typing import Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
@@ -272,6 +272,9 @@ def decode_example(buf: bytes) -> Dict[str, Tuple[str, FeatureValue]]:
 # ---------------------------------------------------------------------------
 
 LABEL_KEY = "label"
+# Optional second task label (e.g. conversion for --tasks ctr,cvr). Absent
+# from single-task files; decode defaults it to 0.0.
+LABEL2_KEY = "label2"
 # On-disk keys as written by the reference converter
 # (tools/libsvm_to_tfrecord.py:25-33).
 IDS_KEY = "ids"
@@ -282,13 +285,23 @@ LEGACY_IDS_KEY = "feat_ids"
 LEGACY_VALS_KEY = "feat_vals"
 
 
-def encode_ctr_example(label: float, ids: np.ndarray, vals: np.ndarray) -> bytes:
-    """Encode the reference CTR schema (tools/libsvm_to_tfrecord.py:25-33)."""
-    return encode_example({
+def encode_ctr_example(label: float, ids: np.ndarray, vals: np.ndarray,
+                       label2: Optional[float] = None) -> bytes:
+    """Encode the reference CTR schema (tools/libsvm_to_tfrecord.py:25-33).
+
+    ``label2`` (second-task label) is appended as an extra ``label2`` float
+    key when given; with ``label2=None`` the output is byte-identical to the
+    historical single-label encoding, so existing files and golden bytes are
+    unaffected.
+    """
+    features = {
         LABEL_KEY: (np.asarray([label], np.float32), "float"),
         IDS_KEY: (np.asarray(ids, np.int64), "int64"),
         VALS_KEY: (np.asarray(vals, np.float32), "float"),
-    })
+    }
+    if label2 is not None:
+        features[LABEL2_KEY] = (np.asarray([label2], np.float32), "float")
+    return encode_example(features)
 
 
 def decode_ctr_example(buf: bytes, field_size: int) -> Tuple[float, np.ndarray, np.ndarray]:
@@ -319,3 +332,26 @@ def decode_ctr_example(buf: bytes, field_size: int) -> Tuple[float, np.ndarray, 
         raise ValueError(
             f"expected field_size={field_size}, got ids={ids.shape[0]} vals={vals.shape[0]}")
     return float(np.asarray(label, np.float32)[0]), ids, vals
+
+
+def decode_ctr_example2(
+        buf: bytes, field_size: int
+) -> Tuple[float, float, np.ndarray, np.ndarray]:
+    """Two-label variant of :func:`decode_ctr_example` for multi-task data.
+
+    Returns ``(label, label2, ids, vals)``; ``label2`` defaults to 0.0 when
+    the key is absent (single-task files remain readable as multi-task input
+    with an all-negative second task). This is the bit-identical Python
+    mirror of the native ``dfm_decode_ctr2_ex`` entry.
+    """
+    feats = decode_example(buf)
+    label, ids, vals = decode_ctr_example(buf, field_size)
+    label2 = 0.0
+    if LABEL2_KEY in feats:
+        _, l2 = feats[LABEL2_KEY]
+        l2 = np.asarray(l2, np.float32)
+        if l2.shape[0] != 1:
+            raise ValueError(
+                f"'label2' must be a single float, got {l2.shape[0]} values")
+        label2 = float(l2[0])
+    return label, label2, ids, vals

@@ -186,9 +186,17 @@ class TieredEmbeddingRuntime:
             full = np.asarray(jax.device_get(params[name]), np.float32)
             real = full[: self.feature_size]  # pad rows are zero; drop them
             self.cold[name] = ColdStore(real, self.cfg.embedding_cold_dtype)
-            self.cold_m[name] = np.zeros(real.shape, np.float32)
-            self.cold_v[name] = np.zeros(real.shape, np.float32)
-            self.cold_tau[name] = np.zeros((self.feature_size,), np.int32)
+            # Seed the cold moment slots from the state being adopted: zeros
+            # for a fresh init (unchanged behavior), the restored Adam
+            # moments when the state came from a densified checkpoint — the
+            # dense->tiered restore direction is then bit-exact.
+            entry = embed[name]["table"]
+            self.cold_m[name] = np.asarray(
+                jax.device_get(entry.m), np.float32)[: self.feature_size].copy()
+            self.cold_v[name] = np.asarray(
+                jax.device_get(entry.v), np.float32)[: self.feature_size].copy()
+            self.cold_tau[name] = np.asarray(
+                jax.device_get(entry.tau), np.int32)[: self.feature_size].copy()
             hot_shape = (self.hot_rows,) + real.shape[1:]
             params[name] = jnp.zeros(hot_shape, jnp.float32)
             from ..train import optimizers as opt_lib  # noqa: PLC0415
@@ -455,6 +463,29 @@ class TieredEmbeddingRuntime:
             full[: self.feature_size] = real
             params[name] = jnp.asarray(full)
         return state.replace(params=params)
+
+    def checkpoint_state(self, state):
+        """The state an UNTIERED run would checkpoint: full densified
+        params PLUS full-shape embedding Adam slots (hot window flushed
+        back, cold rows merged, pad rows zero). A checkpoint written from
+        this state restores bit-exactly into a dense run, a differently
+        sized hot cache, or back into this one (via adopt-after-restore)."""
+        state = self.densified(state)  # flush() inside syncs cold_m/v/tau
+        opt = dict(state.opt_state)
+        embed = dict(opt["embed"])
+        pv = self.model.emb.padded_vocab
+        from ..train import optimizers as opt_lib  # noqa: PLC0415
+        for name in self.names:
+            m = np.zeros((pv,) + self.cold[name].shape[1:], np.float32)
+            v = np.zeros((pv,) + self.cold[name].shape[1:], np.float32)
+            tau = np.zeros((pv,), np.int32)
+            m[: self.feature_size] = self.cold_m[name]
+            v[: self.feature_size] = self.cold_v[name]
+            tau[: self.feature_size] = self.cold_tau[name]
+            embed[name] = {"table": opt_lib.EmbedAdamEntry(
+                m=jnp.asarray(m), v=jnp.asarray(v), tau=jnp.asarray(tau))}
+        opt["embed"] = embed
+        return state.replace(opt_state=opt)
 
     def hit_rate(self) -> float:
         n = self.stats["lookups"]

@@ -98,6 +98,7 @@ def generate_synthetic_ctr(
     prefix: str = "tr",
     seed: int = 0,
     hidden_seed: int = 12345,
+    num_labels: int = 1,
 ) -> List[str]:
     """Write synthetic Criteo-shaped TFRecords with a learnable signal.
 
@@ -108,10 +109,21 @@ def generate_synthetic_ctr(
     label-generating model independently of ``seed`` (the example sampler),
     so train/eval/test splits generated with different seeds share the same
     ground-truth mapping.
+
+    With ``num_labels=2`` each Example additionally carries a ``label2``
+    (conversion) key generated from a SECOND hidden vector and gated on the
+    click (label2 can be 1 only when label is 1 — the ESMM entire-space
+    setup), so both tasks are learnable and realistically correlated. With
+    the default ``num_labels=1`` no extra rng draws happen and the output
+    is byte-identical to previous versions.
     """
+    if num_labels not in (1, 2):
+        raise ValueError(f"num_labels must be 1 or 2, got {num_labels}")
     os.makedirs(out_dir, exist_ok=True)
     rng = np.random.default_rng(seed)
     hidden_w = np.random.default_rng(hidden_seed).normal(
+        0, 1.0, size=feature_size).astype(np.float32)
+    hidden_w2 = np.random.default_rng(hidden_seed + 1).normal(
         0, 1.0, size=feature_size).astype(np.float32)
     paths = []
     for fi in range(num_files):
@@ -123,5 +135,14 @@ def generate_synthetic_ctr(
                 vals = rng.normal(0, 1, size=field_size).astype(np.float32)
                 logit = float(np.dot(hidden_w[ids], vals)) * 0.5
                 label = float(rng.random() < 1.0 / (1.0 + np.exp(-logit)))
-                w.write(example_codec.encode_ctr_example(label, ids, vals))
+                if num_labels == 1:
+                    w.write(example_codec.encode_ctr_example(label, ids, vals))
+                    continue
+                label2 = 0.0
+                if label > 0:
+                    logit2 = float(np.dot(hidden_w2[ids], vals)) * 0.5
+                    label2 = float(
+                        rng.random() < 1.0 / (1.0 + np.exp(-logit2)))
+                w.write(example_codec.encode_ctr_example(
+                    label, ids, vals, label2=label2))
     return paths

@@ -15,6 +15,8 @@ TPU-native re-design of the reference's two ``input_fn`` flavors
 
 Outputs fixed-shape batches ``{"feat_ids": int32[B,F], "feat_vals": f32[B,F],
 "label": f32[B,1]}`` — static shapes so every step hits the same XLA program.
+With ``num_labels=2`` (multi-task training, ``--tasks ctr,cvr``) batches gain
+a ``"label2"`` f32[B,1] column decoded from the optional on-disk key.
 """
 
 from __future__ import annotations
@@ -49,6 +51,26 @@ def decode_batch_python(records: Sequence[bytes], field_size: int) -> Tuple[np.n
     return labels, ids, vals
 
 
+def decode_batch2_python(records: Sequence[bytes], field_size: int
+                         ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                    np.ndarray]:
+    """Two-label decode fallback (multi-task input): ``label2`` defaults to
+    0.0 for single-label records. Mirrors ``native.loader.decode_batch2``."""
+    n = len(records)
+    labels = np.empty((n,), np.float32)
+    labels2 = np.empty((n,), np.float32)
+    ids = np.empty((n, field_size), np.int32)
+    vals = np.empty((n, field_size), np.float32)
+    for i, rec in enumerate(records):
+        lab, lab2, rid, rval = example_codec.decode_ctr_example2(
+            rec, field_size)
+        labels[i] = lab
+        labels2[i] = lab2
+        ids[i] = rid.astype(np.int32)
+        vals[i] = rval
+    return labels, labels2, ids, vals
+
+
 def _get_decoder(use_native: bool):
     if use_native:
         try:
@@ -58,6 +80,18 @@ def _get_decoder(use_native: bool):
         except Exception:
             pass
     return decode_batch_python
+
+
+def _get_decoder2(use_native: bool):
+    """Two-label sibling of ``_get_decoder`` (same fallback discipline)."""
+    if use_native:
+        try:
+            from ..native import loader  # noqa: PLC0415
+            if loader.available():
+                return loader.decode_batch2
+        except Exception:
+            pass
+    return decode_batch2_python
 
 
 # Chunk size for the native streaming reader: big enough to amortize the
@@ -327,6 +361,7 @@ class CtrPipeline:
         stall_timeout_s: float = 0.0,
         decoded_cache: str = "off",
         decoded_cache_dir: str = "",
+        num_labels: int = 1,
     ):
         if shard is not None:
             self._files: Tuple[str, ...] = shard.files
@@ -375,7 +410,24 @@ class CtrPipeline:
         # skip along the k=1 pooled stream while training had consumed the
         # k-pooled stream, whose batch order differs past the first drain.
         self.skip_batches = skip_batches
+        # Multi-label emission (--tasks ctr,cvr): batches gain a "label2"
+        # [B, 1] column decoded from the optional on-disk key. The
+        # multi-label stream takes the eager decode path only — the fused
+        # drain entry, the shm worker slabs, and the decoded cache are
+        # single-label layouts by design, so they are forced off here
+        # rather than silently dropping the second column.
+        self.num_labels = max(1, int(num_labels))
+        if self.num_labels > 2:
+            raise ValueError(
+                f"num_labels must be 1 or 2, got {num_labels} (the on-disk "
+                "schema carries at most one extra 'label2' column)")
+        if self.num_labels > 1:
+            input_workers = 0
+            native_assembly = False
+            self.native_assembly = False
+            decoded_cache = "off"
         self._decode = _get_decoder(use_native_decoder)
+        self._decode2 = _get_decoder2(use_native_decoder)
         # Multi-process input service (opt-in, see workers.py): decode
         # worker processes feed shared-memory slabs; 0 = in-process decode
         # (the default path, byte-for-byte unchanged). Engaged only where
@@ -430,6 +482,10 @@ class CtrPipeline:
         consumption preserves deterministic chunk order."""
         def decode(job: Tuple[bytes, np.ndarray, np.ndarray]):
             buf, offsets, lengths = job
+            if self.num_labels > 1:
+                labels, labels2, ids, vals = loader.decode_spans2(
+                    buf, offsets, lengths, self.field_size)
+                return np.stack([labels, labels2], axis=1), ids, vals
             return loader.decode_spans(buf, offsets, lengths, self.field_size)
 
         jobs = self._iter_framed_span_chunks(epoch, loader)
@@ -750,7 +806,7 @@ class CtrPipeline:
         # pool_target — a world-fold RSS regression; the eager path decodes
         # (only) the kept rows and frees each buffer immediately.
         fused = (not use_shm and self.shuffle and loader is not None
-                 and self._record_shard is None
+                 and self._record_shard is None and self.num_labels == 1
                  and hasattr(loader, "decode_spans_scatter"))
         # Drain-decode executor: per-ITERATOR, not per-pipeline — two live
         # iterators of one pipeline must not share (advisor r5: the first
@@ -788,7 +844,8 @@ class CtrPipeline:
                             # ``label`` array (the 1-D pool forced a full
                             # reshape+astype copy per emission). Same bytes,
                             # one less pass per batch.
-                            labels = np.empty((n_pend, 1), np.float32)
+                            labels = np.empty((n_pend, self.num_labels),
+                                              np.float32)
                             lab_col = labels.reshape(-1)
                             ids = np.empty((n_pend, self.field_size),
                                            np.int32)
@@ -797,7 +854,10 @@ class CtrPipeline:
                             off = 0
                             for lab, idx, val in pend:
                                 dest = perm[off:off + len(lab)]
-                                lab_col[dest] = lab.reshape(-1)
+                                if self.num_labels == 1:
+                                    lab_col[dest] = lab.reshape(-1)
+                                else:
+                                    labels[dest] = lab.reshape(len(lab), -1)
                                 ids[dest] = idx
                                 vals[dest] = val
                                 off += len(lab)
@@ -930,6 +990,15 @@ class CtrPipeline:
         # passes through as a zero-copy view — same bytes, no per-emission
         # label copy. Non-contiguous or 1-D chunk labels still normalize
         # to the same [bs, 1] float32 layout.
+        if labels.ndim == 2 and labels.shape[1] > 1:
+            # Multi-label chunks ([n, 2] columns): split into the batch
+            # contract's named [bs, 1] label columns.
+            return {
+                "feat_ids": np.ascontiguousarray(ids, np.int32),
+                "feat_vals": np.ascontiguousarray(vals, np.float32),
+                "label": np.ascontiguousarray(labels[:, :1], np.float32),
+                "label2": np.ascontiguousarray(labels[:, 1:2], np.float32),
+            }
         return {
             "feat_ids": np.ascontiguousarray(ids, np.int32),
             "feat_vals": np.ascontiguousarray(vals, np.float32),
@@ -992,6 +1061,15 @@ class CtrPipeline:
                     yield self._make_batch(pending)
 
     def _make_batch(self, records: List[bytes]) -> Batch:
+        if self.num_labels > 1:
+            labels, labels2, ids, vals = self._decode2(
+                records, self.field_size)
+            return {
+                "feat_ids": np.ascontiguousarray(ids, np.int32),
+                "feat_vals": np.ascontiguousarray(vals, np.float32),
+                "label": labels.reshape(-1, 1).astype(np.float32),
+                "label2": labels2.reshape(-1, 1).astype(np.float32),
+            }
         labels, ids, vals = self._decode(records, self.field_size)
         return {
             "feat_ids": np.ascontiguousarray(ids, np.int32),
@@ -1114,6 +1192,7 @@ class StreamingCtrPipeline:
         max_bad_records: int = 0,
         stream_label: str = "<stream>",
         health: Optional[DataHealth] = None,
+        num_labels: int = 1,
     ):
         self.stream = stream
         self.field_size = field_size
@@ -1122,6 +1201,11 @@ class StreamingCtrPipeline:
         self.prefetch_batches = prefetch_batches
         self._use_native = use_native_decoder
         self._decode = _get_decoder(use_native_decoder)
+        self._decode2 = _get_decoder2(use_native_decoder)
+        self.num_labels = max(1, int(num_labels))
+        if self.num_labels > 2:
+            raise ValueError(
+                f"num_labels must be 1 or 2, got {num_labels}")
         self._record_shard = record_shard
         self.verify_crc = verify_crc
         self.skip_batches = skip_batches  # resume: drop the trained prefix
@@ -1172,8 +1256,13 @@ class StreamingCtrPipeline:
                 path=self._stream_label, policy=self._bad_policy):
             if len(offsets) == 0:
                 continue
-            labels, ids, vals = loader.decode_spans(
-                buf, offsets, lengths, self.field_size)
+            if self.num_labels > 1:
+                lab1, lab2, ids, vals = loader.decode_spans2(
+                    buf, offsets, lengths, self.field_size)
+                labels = np.stack([lab1, lab2], axis=1)
+            else:
+                labels, ids, vals = loader.decode_spans(
+                    buf, offsets, lengths, self.field_size)
             if self._record_shard is not None:
                 world, rank = self._record_shard
                 keep = (np.arange(n_seen, n_seen + len(labels))
@@ -1193,26 +1282,33 @@ class StreamingCtrPipeline:
         if n_pend and not self.drop_remainder:
             yield CtrPipeline._assemble_batch(pend, n_pend), 1, n_pend
 
+    def _batch_from_records(self, records: List[bytes]) -> Batch:
+        if self.num_labels > 1:
+            labels, labels2, ids, vals = self._decode2(
+                records, self.field_size)
+            return {
+                "feat_ids": np.ascontiguousarray(ids, np.int32),
+                "feat_vals": np.ascontiguousarray(vals, np.float32),
+                "label": labels.reshape(-1, 1).astype(np.float32),
+                "label2": labels2.reshape(-1, 1).astype(np.float32),
+            }
+        labels, ids, vals = self._decode(records, self.field_size)
+        return {
+            "feat_ids": np.ascontiguousarray(ids, np.int32),
+            "feat_vals": np.ascontiguousarray(vals, np.float32),
+            "label": labels.reshape(-1, 1).astype(np.float32),
+        }
+
     def _iter_record_batches(self) -> Iterator[Batch]:
         """Pure-Python fallback: per-record framing + batched decode."""
         pending: List[bytes] = []
         for rec in self._iter_records():
             pending.append(rec)
             if len(pending) == self.batch_size:
-                labels, ids, vals = self._decode(pending, self.field_size)
-                yield {
-                    "feat_ids": np.ascontiguousarray(ids, np.int32),
-                    "feat_vals": np.ascontiguousarray(vals, np.float32),
-                    "label": labels.reshape(-1, 1).astype(np.float32),
-                }
+                yield self._batch_from_records(pending)
                 pending = []
         if pending and not self.drop_remainder:
-            labels, ids, vals = self._decode(pending, self.field_size)
-            yield {
-                "feat_ids": np.ascontiguousarray(ids, np.int32),
-                "feat_vals": np.ascontiguousarray(vals, np.float32),
-                "label": labels.reshape(-1, 1).astype(np.float32),
-            }
+            yield self._batch_from_records(pending)
 
     def _iter_sync(self) -> Iterator[Batch]:
         if self._consumed:

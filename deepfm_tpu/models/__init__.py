@@ -1,22 +1,38 @@
-"""Model zoo registry: deepfm | widedeep | dcnv2 (BASELINE.json configs)."""
+"""Model zoo registry: single-task graphs + the multi-task head wrapper.
+
+``get_model`` is the one dispatch point: a config with more than one task
+(``--tasks ctr,cvr``) builds the multi-task model (``--multitask``
+architecture over the shared graph bottom); otherwise ``cfg.model`` picks a
+single-task graph from the registry.
+"""
 
 from typing import Union
 
 from ..config import Config
 from .dcnv2 import DCNv2  # noqa: F401
 from .deepfm import DeepFM  # noqa: F401
+from .graph import DLRM  # noqa: F401
+from .multitask import MultiTaskModel  # noqa: F401
 from .widedeep import WideDeep  # noqa: F401
 
 _REGISTRY = {
     "deepfm": DeepFM,
     "widedeep": WideDeep,
     "dcnv2": DCNv2,
+    "dlrm": DLRM,
 }
 
-CtrModel = Union[DeepFM, WideDeep, DCNv2]
+CtrModel = Union[DeepFM, WideDeep, DCNv2, DLRM, MultiTaskModel]
+
+
+def registered_models():
+    """Registered single-task model names (the ``--model`` whitelist)."""
+    return sorted(_REGISTRY)
 
 
 def get_model(cfg: Config) -> CtrModel:
+    if cfg.num_tasks > 1:
+        return MultiTaskModel(cfg)
     try:
         return _REGISTRY[cfg.model](cfg)
     except KeyError:

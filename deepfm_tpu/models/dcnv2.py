@@ -8,97 +8,17 @@ DeepFM. Cross layers follow DCN-v2 (Wang et al., 2021):
     x_{l+1} = x_0 * (U_l (V_l x_l) + b_l) + x_l    (low-rank, cross_rank > 0)
 
 The [D, D] cross matmuls (D = F*K) are dense MXU work — this model is the
-dense-interaction stress case of the benchmark suite. Output combines the
-cross tower and the deep tower (stacked-parallel structure): logits =
-b + dense(concat(cross_out, deep_out)).
+dense-interaction stress case of the benchmark suite.
+
+The implementation lives in ``models.graph`` (cross_network block + hidden
+stack + combination head); this class is a thin, bit-identical wrapper kept
+for the public name.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Tuple
-
-import jax
-import jax.numpy as jnp
-
-from ..config import Config
-from . import common
-from .deepfm import DeepFM
+from .graph import GraphDCNv2
 
 
-class DCNv2(DeepFM):
+class DCNv2(GraphDCNv2):
     name = "dcnv2"
-
-    def init(self, rng: jax.Array) -> Tuple[common.Params, common.State]:
-        cfg = self.cfg
-        params, bn_state = super().init(rng)
-        d = cfg.field_size * cfg.embedding_size
-        keys = jax.random.split(jax.random.fold_in(rng, 7), cfg.cross_layers)
-        cross = []
-        for i in range(cfg.cross_layers):
-            if cfg.cross_rank > 0:
-                cross.append({
-                    "u": common.glorot_uniform(keys[i], (cfg.cross_rank, d)),
-                    "v": common.glorot_uniform(
-                        jax.random.fold_in(keys[i], 1), (d, cfg.cross_rank)),
-                    "b": jnp.zeros((d,), jnp.float32),
-                })
-            else:
-                cross.append({
-                    "w": common.glorot_uniform(keys[i], (d, d)),
-                    "b": jnp.zeros((d,), jnp.float32),
-                })
-        params["cross"] = cross
-        # Combination head over concat(cross_out[D], deep_out_hidden).
-        deep_out_dim = cfg.deep_layer_sizes[-1] if cfg.deep_layer_sizes else d
-        params["head"] = {
-            "w": common.glorot_uniform(
-                jax.random.fold_in(rng, 11), (d + deep_out_dim, 1)),
-            "b": jnp.zeros((1,), jnp.float32),
-        }
-        return params, bn_state
-
-    def apply(
-        self,
-        params: common.Params,
-        state: common.State,
-        feat_ids: jnp.ndarray,
-        feat_vals: jnp.ndarray,
-        *,
-        train: bool,
-        rng: Optional[jax.Array] = None,
-        shard_axis: Optional[str] = None,
-        data_axis: Optional[str] = None,
-        emb_rows: Optional[Dict[str, Any]] = None,
-        emb_plan: Optional[Dict[str, Any]] = None,
-    ) -> Tuple[jnp.ndarray, common.State]:
-        cfg = self.cfg
-        cdt = jnp.dtype(cfg.compute_dtype)
-        feat_vals = feat_vals.astype(jnp.float32)
-
-        v = self._emb_lookup(params, "fm_v", feat_ids, shard_axis,
-                             emb_rows, emb_plan)
-        xv = v * feat_vals[..., None]
-        x0 = xv.reshape(xv.shape[0], cfg.field_size * cfg.embedding_size)
-
-        # Cross tower.
-        x0c = x0.astype(cdt)
-        x = x0c
-        for layer in params["cross"]:
-            if "u" in layer:
-                inner = (x @ layer["v"].astype(cdt)) @ layer["u"].astype(cdt)
-            else:
-                inner = x @ layer["w"].astype(cdt)
-            x = x0c * (inner + layer["b"].astype(cdt)) + x
-        cross_out = x
-
-        # Deep tower (hidden stack only; the head combines both towers).
-        h, new_state = common.apply_hidden_stack(
-            params["tower"], state, x0, train=train,
-            dropout_keep=cfg.dropout_rates, use_bn=cfg.batch_norm,
-            bn_decay=cfg.batch_norm_decay, rng=rng, compute_dtype=cdt,
-            data_axis=data_axis)
-
-        combined = jnp.concatenate([cross_out, h.astype(cdt)], axis=1)
-        out = combined @ params["head"]["w"].astype(cdt) + params["head"]["b"].astype(cdt)
-        logits = params["fm_b"][0] + out.astype(jnp.float32)[:, 0]
-        return logits, new_state

@@ -6,122 +6,19 @@ TPU-native reimplementation of the reference ``model_fn`` graph
     y = FM_B + sum_f(W[ids]*vals) + FM(xv) + DNN(flatten(xv)),  pred = sigmoid(y)
 
 with FM_W: [V], FM_V: [V, K] glorot-normal (reference ``:166-168``), the FM
-identity from ``ops.fm``, and the tower from ``models.common``. The embedding
-tables may be row-sharded over the ``model`` mesh axis (``shard_axis``);
-lookups then run as dense masked-gather + psum (``ops.embedding``), replacing
-the reference's PS-hosted table (X1) with an ICI collective.
+identity from ``ops.fm``, and the tower from ``models.common``.
+
+The implementation lives in ``models.graph`` — DeepFM is the graph
+``(fm_w, fm_v) → [fm_block, tower] → ctr head`` (see graph.GraphDeepFM);
+this class is a thin wrapper kept for the public name. Identical key
+derivation and op order make it bit-identical to the pre-graph class
+(pinned by tests/test_models.py's NumPy oracle and tests/test_multitask.py).
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Tuple
-
-import jax
-import jax.numpy as jnp
-
-from ..config import Config
-from ..ops import fm as fm_ops
-from ..ops import pallas_fm
-from . import common
+from .graph import GraphDeepFM
 
 
-class DeepFM:
+class DeepFM(GraphDeepFM):
     name = "deepfm"
-
-    def __init__(self, cfg: Config):
-        self.cfg = cfg
-        self.emb = common.EmbeddingSchema(cfg)
-        self.padded_vocab = self.emb.padded_vocab
-
-    # -- parameters ----------------------------------------------------
-    def init(self, rng: jax.Array) -> Tuple[common.Params, common.State]:
-        cfg = self.cfg
-        k_w, k_v, k_mlp = jax.random.split(rng, 3)
-        fm_w = self.emb.init_entry(k_w, ())
-        fm_v = self.emb.init_entry(k_v, (cfg.embedding_size,))
-        tower, bn_state = common.init_tower(
-            k_mlp, cfg.field_size * cfg.embedding_size, cfg.deep_layer_sizes,
-            cfg.batch_norm)
-        params = {"fm_b": jnp.zeros((1,), jnp.float32),
-                  "fm_w": fm_w, "fm_v": fm_v, "tower": tower}
-        return params, bn_state
-
-    # -- forward -------------------------------------------------------
-    def apply(
-        self,
-        params: common.Params,
-        state: common.State,
-        feat_ids: jnp.ndarray,   # int32 [B, F]
-        feat_vals: jnp.ndarray,  # f32 [B, F]
-        *,
-        train: bool,
-        rng: Optional[jax.Array] = None,
-        shard_axis: Optional[str] = None,
-        data_axis: Optional[str] = None,
-        emb_rows: Optional[Dict[str, Any]] = None,
-        emb_plan: Optional[Dict[str, Any]] = None,
-    ) -> Tuple[jnp.ndarray, common.State]:
-        cfg = self.cfg
-        feat_vals = feat_vals.astype(jnp.float32)
-
-        # First-order: sum_f W[ids]*vals   (reference :177-179)
-        w = self._emb_lookup(params, "fm_w", feat_ids, shard_axis,
-                             emb_rows, emb_plan)  # [B,F]
-        # Second-order FM over xv = V[ids]*vals   (reference :181-187)
-        v = self._emb_lookup(params, "fm_v", feat_ids, shard_axis,
-                             emb_rows, emb_plan)  # [B,F,K]
-        xv = v * feat_vals[..., None]
-        if cfg.use_pallas and pallas_fm.supported(cfg.field_size,
-                                                 cfg.embedding_size):
-            # Fused Pallas path: both FM reductions in one VMEM pass over the
-            # same xv the tower consumes; d(xv)->d(v),d(vals) via JAX's
-            # product rule outside the kernel.
-            y_wv = pallas_fm.fused_fm(w, feat_vals, xv)
-        else:
-            y_wv = jnp.sum(w * feat_vals, axis=1) + fm_ops.fm_interaction(xv)
-
-        # Deep tower over flattened xv   (reference :203-226)
-        deep_in = xv.reshape(xv.shape[0], cfg.field_size * cfg.embedding_size)
-        tower_fn = lambda p, x: common.apply_tower(
-            p, state, x, train=train, dropout_keep=cfg.dropout_rates,
-            use_bn=cfg.batch_norm, bn_decay=cfg.batch_norm_decay, rng=rng,
-            compute_dtype=jnp.dtype(cfg.compute_dtype), data_axis=data_axis)
-        if cfg.remat:
-            y_d, new_state = jax.checkpoint(tower_fn)(params["tower"], deep_in)
-        else:
-            y_d, new_state = tower_fn(params["tower"], deep_in)
-
-        logits = params["fm_b"][0] + y_wv + y_d  # [B] (reference :229-231)
-        return logits, new_state
-
-    def _emb_lookup(self, params: common.Params, name: str,
-                    feat_ids: jnp.ndarray, shard_axis: Optional[str],
-                    emb_rows: Optional[Dict[str, Any]],
-                    emb_plan: Optional[Dict[str, Any]]) -> jnp.ndarray:
-        """Dense gather from the full table, or (sparse-update path) the
-        batch's pre-gathered touched rows — ``emb_rows[name]`` is the
-        gradient leaf there, so AD of this inverse-index gather lowers to
-        the batch-sized segment-sum scatter instead of a full-table one."""
-        if emb_rows is not None:
-            return self.emb.lookup_rows(emb_rows[name], emb_plan)
-        return self.emb.lookup(params[name], feat_ids, axis_name=shard_axis)
-
-    # -- regularization -------------------------------------------------
-    def l2_loss(self, params: common.Params, *,
-                shard_axis: Optional[str] = None,
-                emb_rows: Optional[Dict[str, Any]] = None,
-                emb_plan: Optional[Dict[str, Any]] = None) -> jnp.ndarray:
-        """l2_reg * (l2_loss(FM_W) + l2_loss(FM_V)) — reference :244-246.
-        Pad rows are structurally excluded; the sparse path penalizes only
-        the batch's touched rows (TUNING §2.11)."""
-        if emb_rows is not None:
-            return self.cfg.l2_reg * (
-                self.emb.l2_rows(emb_rows["fm_w"], emb_plan)
-                + self.emb.l2_rows(emb_rows["fm_v"], emb_plan))
-        return self.cfg.l2_reg * (
-            self.emb.l2(params["fm_w"], axis_name=shard_axis)
-            + self.emb.l2(params["fm_v"], axis_name=shard_axis))
-
-    def embedding_param_names(self) -> Tuple[str, ...]:
-        """Top-level param keys that are row-sharded over the model axis."""
-        return ("fm_w", "fm_v")

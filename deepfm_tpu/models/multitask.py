@@ -1,0 +1,216 @@
+"""Multi-task ranking heads over the feature→tower graph.
+
+One embedding stage (the same ``fm_w``/``fm_v`` entries every single-task
+graph uses), a shared bottom chosen by ``--multitask``, and one named head
+per ``--tasks`` entry, each producing a logit — ``apply`` returns ``[B, T]``
+instead of the single-task ``[B]``:
+
+  * ``shared_bottom`` — one shared DNN hidden stack; per-task linear heads.
+  * ``mmoe`` — Multi-gate Mixture-of-Experts (Ma et al., KDD 2018):
+    ``--mmoe_experts`` independent hidden stacks, a per-task softmax gate
+    over the expert outputs, per-task heads on the mixtures.
+  * ``esmm`` — Entire-Space Multi-task Model (Ma et al., SIGIR 2018) for
+    CTR+CVR: per-task towers; the CVR head trains through the observable
+    pCTCVR = pCTR · pCVR on the full exposure space (no sample-selection
+    bias), so the loss couples the tasks while serving stays per-task.
+
+Per-task losses combine under ``--task_weights`` (default: all 1.0).
+Labels arrive as columns of the batch dict: task 0 reads ``label``, task 1
+the optional ``label2`` column (see data/example_codec.py).
+
+Sparse embedding updates, row-sharding, and the serving export all work
+unchanged: the embedding stage is inherited from :class:`graph.GraphModel`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..config import Config
+from . import common
+from . import graph
+
+
+class MultiTaskModel(graph.GraphModel):
+    """Named task heads over a shared embedding + interaction bottom."""
+
+    def __init__(self, cfg: Config):
+        super().__init__(cfg)
+        self.task_names = tuple(cfg.task_names)
+        self.arch = cfg.multitask
+        self.name = f"multitask_{self.arch}"
+
+    # -- parameters ----------------------------------------------------
+    def init(self, rng: jax.Array) -> Tuple[common.Params, common.State]:
+        cfg = self.cfg
+        t_count = self.num_tasks
+        k_w, k_v, k_body = jax.random.split(rng, 3)
+        fm_w = self.emb.init_entry(k_w, ())
+        fm_v = self.emb.init_entry(k_v, (cfg.embedding_size,))
+        d = cfg.field_size * cfg.embedding_size
+        hdim = cfg.deep_layer_sizes[-1] if cfg.deep_layer_sizes else d
+        params: common.Params = {
+            "fm_b": jnp.zeros((t_count,), jnp.float32),
+            "fm_w": fm_w, "fm_v": fm_v,
+        }
+        if self.arch == "esmm":
+            towers: List[common.Params] = []
+            states: List[common.State] = []
+            for t in range(t_count):
+                tp, ts = common.init_tower(
+                    jax.random.fold_in(k_body, t), d, cfg.deep_layer_sizes,
+                    cfg.batch_norm)
+                towers.append(tp)
+                states.append(ts)
+            params["towers"] = towers
+            return params, {"towers": states}
+        if self.arch == "mmoe":
+            ekeys = jax.random.split(
+                jax.random.fold_in(k_body, 1), cfg.mmoe_experts)
+            experts, estates = [], []
+            for i in range(cfg.mmoe_experts):
+                ep, es = common.init_hidden_stack(
+                    ekeys[i], d, cfg.deep_layer_sizes, cfg.batch_norm)
+                experts.append(ep)
+                estates.append(es)
+            params["experts"] = experts
+            k_gate = jax.random.fold_in(k_body, 2)
+            params["gates"] = [
+                {"w": common.glorot_uniform(
+                    jax.random.fold_in(k_gate, t), (d, cfg.mmoe_experts))}
+                for t in range(t_count)]
+            k_head = jax.random.fold_in(k_body, 3)
+            params["heads"] = [self._init_head(
+                jax.random.fold_in(k_head, t), hdim) for t in range(t_count)]
+            return params, {"experts": estates}
+        # shared_bottom
+        bp, bs = common.init_hidden_stack(
+            jax.random.fold_in(k_body, 1), d, cfg.deep_layer_sizes,
+            cfg.batch_norm)
+        params["bottom"] = bp
+        k_head = jax.random.fold_in(k_body, 3)
+        params["heads"] = [self._init_head(
+            jax.random.fold_in(k_head, t), hdim) for t in range(t_count)]
+        return params, {"bottom": bs}
+
+    @staticmethod
+    def _init_head(key: jax.Array, hdim: int) -> common.Params:
+        return {"w": common.glorot_uniform(key, (hdim, 1)),
+                "b": jnp.zeros((1,), jnp.float32)}
+
+    @staticmethod
+    def _apply_head(head: common.Params, h: jnp.ndarray) -> jnp.ndarray:
+        out = h @ head["w"].astype(h.dtype) + head["b"].astype(h.dtype)
+        return out.astype(jnp.float32)[:, 0]
+
+    # -- forward -------------------------------------------------------
+    def apply(
+        self,
+        params: common.Params,
+        state: common.State,
+        feat_ids: jnp.ndarray,   # int32 [B, F]
+        feat_vals: jnp.ndarray,  # f32 [B, F]
+        *,
+        train: bool,
+        rng: Optional[jax.Array] = None,
+        shard_axis: Optional[str] = None,
+        data_axis: Optional[str] = None,
+        emb_rows: Optional[Dict[str, Any]] = None,
+        emb_plan: Optional[Dict[str, Any]] = None,
+    ) -> Tuple[jnp.ndarray, common.State]:
+        """Returns per-task logits [B, T] + new model state."""
+        cfg = self.cfg
+        cdt = jnp.dtype(cfg.compute_dtype)
+        feat_vals = feat_vals.astype(jnp.float32)
+
+        # Shared embedding stage: linear term + embedded features.
+        w = self._emb_lookup(params, "fm_w", feat_ids, shard_axis,
+                             emb_rows, emb_plan)  # [B,F]
+        v = self._emb_lookup(params, "fm_v", feat_ids, shard_axis,
+                             emb_rows, emb_plan)  # [B,F,K]
+        xv = v * feat_vals[..., None]
+        y_first = graph.first_order(w, feat_vals)  # shared wide term [B]
+        deep_in = xv.reshape(xv.shape[0],
+                             cfg.field_size * cfg.embedding_size)
+        stack_kw = dict(
+            train=train, dropout_keep=cfg.dropout_rates,
+            use_bn=cfg.batch_norm, bn_decay=cfg.batch_norm_decay,
+            compute_dtype=cdt, data_axis=data_axis)
+
+        if self.arch == "esmm":
+            outs, states = [], []
+            for t in range(self.num_tasks):
+                r = None if rng is None else jax.random.fold_in(rng, t)
+                y, ns = common.apply_tower(
+                    params["towers"][t], state["towers"][t], deep_in,
+                    rng=r, **stack_kw)
+                outs.append(params["fm_b"][t] + y_first + y)
+                states.append(ns)
+            return jnp.stack(outs, axis=1), {"towers": states}
+
+        if self.arch == "mmoe":
+            eouts, estates = [], []
+            for i, ep in enumerate(params["experts"]):
+                r = None if rng is None else jax.random.fold_in(rng, i)
+                h, ns = common.apply_hidden_stack(
+                    ep, state["experts"][i], deep_in, rng=r, **stack_kw)
+                eouts.append(h)
+                estates.append(ns)
+            eo = jnp.stack(eouts, axis=1)  # [B, N, H]
+            x0c = deep_in.astype(cdt)
+            outs = []
+            for t in range(self.num_tasks):
+                gate = jax.nn.softmax(
+                    x0c @ params["gates"][t]["w"].astype(cdt), axis=-1)
+                mix = jnp.sum(eo * gate[..., None].astype(eo.dtype), axis=1)
+                outs.append(params["fm_b"][t] + y_first
+                            + self._apply_head(params["heads"][t], mix))
+            return jnp.stack(outs, axis=1), {"experts": estates}
+
+        # shared_bottom
+        h, ns = common.apply_hidden_stack(
+            params["bottom"], state["bottom"], deep_in, rng=rng, **stack_kw)
+        outs = [params["fm_b"][t] + y_first
+                + self._apply_head(params["heads"][t], h)
+                for t in range(self.num_tasks)]
+        return jnp.stack(outs, axis=1), {"bottom": ns}
+
+    # -- task combination ----------------------------------------------
+    def per_example_loss(self, logits: jnp.ndarray,
+                         labels: jnp.ndarray) -> jnp.ndarray:
+        """Weighted per-example combined loss: [B,T] logits+labels -> [B].
+
+        ESMM replaces the independent per-task losses with its entire-space
+        pair: BCE(pCTR, y_ctr) + BCE(pCTR·pCVR, y_ctr·y_cvr). The task
+        weights still apply per term.
+        """
+        cfg = self.cfg
+        wts = jnp.asarray(cfg.task_weight_values, jnp.float32)
+        labels = labels.astype(jnp.float32)
+        if self.arch == "esmm":
+            y_ctr = labels[:, 0]
+            y_cvr = labels[:, 1]
+            l_ctr = optax.sigmoid_binary_cross_entropy(logits[:, 0], y_ctr)
+            eps = jnp.float32(1e-7)
+            p_ctcvr = jnp.clip(
+                jax.nn.sigmoid(logits[:, 0]) * jax.nn.sigmoid(logits[:, 1]),
+                eps, 1.0 - eps)
+            y_ctcvr = y_ctr * y_cvr
+            l_ctcvr = -(y_ctcvr * jnp.log(p_ctcvr)
+                        + (1.0 - y_ctcvr) * jnp.log1p(-p_ctcvr))
+            return wts[0] * l_ctr + wts[1] * l_ctcvr
+        if cfg.loss_type == "log_loss":
+            per_task = optax.sigmoid_binary_cross_entropy(logits, labels)
+        else:  # square_loss
+            per_task = jnp.square(jax.nn.sigmoid(logits) - labels)
+        return per_task @ wts
+
+    def probs_from_logits(self, logits: jnp.ndarray) -> jnp.ndarray:
+        """Named per-task probabilities [B,T] (column t = task_names[t]).
+        For ESMM the CVR column is the *conditional* CVR — multiply the
+        columns to recover pCTCVR downstream if needed."""
+        return jax.nn.sigmoid(logits)

@@ -3,55 +3,15 @@
 BASELINE.json config 4: "swap FM tower for linear wide part, same TFRecord
 input". Same input contract and embedding tables as DeepFM; the model drops
 the second-order FM term, keeping y = b + wide(ids, vals) + DNN(xv).
+
+The implementation lives in ``models.graph`` (first_order block + tower);
+this class is a thin, bit-identical wrapper kept for the public name.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Tuple
-
-import jax
-import jax.numpy as jnp
-
-from ..config import Config
-from . import common
-from .deepfm import DeepFM
+from .graph import GraphWideDeep
 
 
-class WideDeep(DeepFM):
+class WideDeep(GraphWideDeep):
     name = "widedeep"
-
-    def apply(
-        self,
-        params: common.Params,
-        state: common.State,
-        feat_ids: jnp.ndarray,
-        feat_vals: jnp.ndarray,
-        *,
-        train: bool,
-        rng: Optional[jax.Array] = None,
-        shard_axis: Optional[str] = None,
-        data_axis: Optional[str] = None,
-        emb_rows: Optional[Dict[str, Any]] = None,
-        emb_plan: Optional[Dict[str, Any]] = None,
-    ) -> Tuple[jnp.ndarray, common.State]:
-        cfg = self.cfg
-        feat_vals = feat_vals.astype(jnp.float32)
-
-        # Wide: linear over sparse features (first-order part of DeepFM).
-        w = self._emb_lookup(params, "fm_w", feat_ids, shard_axis,
-                             emb_rows, emb_plan)
-        y_wide = jnp.sum(w * feat_vals, axis=1)
-
-        # Deep: tower over embedded features.
-        v = self._emb_lookup(params, "fm_v", feat_ids, shard_axis,
-                             emb_rows, emb_plan)
-        xv = v * feat_vals[..., None]
-        deep_in = xv.reshape(xv.shape[0], cfg.field_size * cfg.embedding_size)
-        y_d, new_state = common.apply_tower(
-            params["tower"], state, deep_in, train=train,
-            dropout_keep=cfg.dropout_rates, use_bn=cfg.batch_norm,
-            bn_decay=cfg.batch_norm_decay, rng=rng,
-            compute_dtype=jnp.dtype(cfg.compute_dtype), data_axis=data_axis)
-
-        logits = params["fm_b"][0] + y_wide + y_d
-        return logits, new_state

@@ -109,6 +109,19 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_long)]
         except AttributeError:
             pass
+        # Two-label decode entry (multi-task input): same stale-.so probe
+        # discipline as the assemble entry above; callers key off
+        # has_labels2() and fall back to the Python codec mirror.
+        try:
+            lib.dfm_decode_ctr2_ex.restype = ctypes.c_long
+            lib.dfm_decode_ctr2_ex.argtypes = [
+                ctypes.POINTER(ctypes.c_ubyte), ctypes.POINTER(ctypes.c_long),
+                ctypes.POINTER(ctypes.c_long), ctypes.c_long, ctypes.c_long,
+                ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_long)]
+        except AttributeError:
+            pass
         lib.dfm_crc32c.restype = ctypes.c_uint32
         lib.dfm_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_long]
         _lib = lib
@@ -214,8 +227,77 @@ def _decode_reason(code: int, field_size: int) -> str:
         -22: f"'values' length != field_size={field_size}",
         -23: ("required keys missing — need 'label' plus 'ids'/'values' "
               "(reference schema) or 'feat_ids'/'feat_vals' (legacy)"),
+        -24: "'label2' is not a single float",
     }
     return reasons.get(code, f"malformed Example wire data (code {code})")
+
+
+def has_labels2() -> bool:
+    """True when the built library exports the two-label decode entry
+    (``dfm_decode_ctr2_ex``). False on a stale cached .so — callers fall
+    back to the Python codec mirror, which emits identical values."""
+    lib = _load()
+    return lib is not None and hasattr(lib, "dfm_decode_ctr2_ex")
+
+
+def decode_spans2(buf, offsets: np.ndarray, lengths: np.ndarray,
+                  field_size: int
+                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Two-label variant of :func:`decode_spans` for multi-task input:
+    returns ``(labels, labels2, ids, vals)`` with ``labels2[i]`` from the
+    optional ``label2`` key (0.0 when absent). Falls back to the
+    bit-identical Python codec mirror when the cached library predates the
+    entry (same discipline as ``assemble_spans``)."""
+    lib = _load()
+    n = len(offsets)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    lengths = np.ascontiguousarray(lengths, dtype=np.int64)
+    if lib is None or not hasattr(lib, "dfm_decode_ctr2_ex"):
+        from ..data import example_codec  # noqa: PLC0415 (avoid module cycle)
+        labels = np.empty(n, dtype=np.float32)
+        labels2 = np.empty(n, dtype=np.float32)
+        ids = np.empty((n, field_size), dtype=np.int32)
+        vals = np.empty((n, field_size), dtype=np.float32)
+        for i, (off, ln) in enumerate(zip(offsets.tolist(), lengths.tolist())):
+            lab, lab2, rid, rval = example_codec.decode_ctr_example2(
+                bytes(buf[off:off + ln]), field_size)
+            labels[i] = lab
+            labels2[i] = lab2
+            ids[i] = rid.astype(np.int32)
+            vals[i] = rval
+        return labels, labels2, ids, vals
+    labels = np.empty(n, dtype=np.float32)
+    labels2 = np.empty(n, dtype=np.float32)
+    ids = np.empty((n, field_size), dtype=np.int32)
+    vals = np.empty((n, field_size), dtype=np.float32)
+    detail = ctypes.c_long(0)
+    rc = lib.dfm_decode_ctr2_ex(
+        _as_ubyte_ptr(buf),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        n, field_size,
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        labels2.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.byref(detail))
+    if rc != 0:
+        raise ValueError(f"native 2-label decode failed at record "
+                         f"{-rc - 100}: "
+                         f"{_decode_reason(detail.value, field_size)}")
+    return labels, labels2, ids, vals
+
+
+def decode_batch2(records: Sequence[bytes], field_size: int
+                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Two-label sibling of :func:`decode_batch`."""
+    buf = b"".join(records)
+    lengths = np.fromiter((len(r) for r in records), dtype=np.int64,
+                          count=len(records))
+    offsets = np.zeros(len(records), dtype=np.int64)
+    if len(records) > 1:
+        np.cumsum(lengths[:-1], out=offsets[1:])
+    return decode_spans2(buf, offsets, lengths, field_size)
 
 
 def decode_spans_scatter(buf, offsets: np.ndarray, lengths: np.ndarray,

@@ -168,10 +168,15 @@ inline bool key_is(const KeyRef& k, const char* s) {
   return k.len == sl && std::memcmp(k.p, s, sl) == 0;
 }
 
-// Parse one serialized Example. Returns 0 ok, negative error.
+// Parse one serialized Example. Returns 0 ok, negative error. label2 (when
+// non-null) receives the optional "label2" float key, defaulting to 0.0f
+// when the key is absent — single-label files stay decodable as multi-task
+// input; existing callers pass nullptr and are untouched.
 long parse_ctr_example(const uint8_t* p, const uint8_t* end, long field_size,
-                       float* label, int32_t* ids, float* vals) {
+                       float* label, int32_t* ids, float* vals,
+                       float* label2 = nullptr) {
   bool got_label = false, got_ids = false, got_vals = false;
+  if (label2) *label2 = 0.0f;
   while (p < end) {
     uint64_t tag;
     if (!read_varint(p, end, &tag)) return -10;
@@ -233,6 +238,8 @@ long parse_ctr_example(const uint8_t* p, const uint8_t* end, long field_size,
       if (key_is(key, "label") && vfield == 2) {
         if (parse_float_list(payload, pend, label, 1) != 1) return -20;
         got_label = true;
+      } else if (label2 && key_is(key, "label2") && vfield == 2) {
+        if (parse_float_list(payload, pend, label2, 1) != 1) return -24;
       } else if ((key_is(key, "ids") || key_is(key, "feat_ids")) &&
                  vfield == 3) {
         if (parse_int64_list(payload, pend, ids, field_size) != field_size)
@@ -337,6 +344,27 @@ long dfm_decode_ctr(const uint8_t* buf, const long* offsets, const long* lengths
                     float* vals) {
   return dfm_decode_ctr_ex(buf, offsets, lengths, n, field_size, labels, ids,
                            vals, nullptr);
+}
+
+// Two-label decode for multi-task training (--tasks ctr,cvr): additionally
+// fills labels2[n] from the optional "label2" float key, 0.0 when absent.
+// Error contract matches dfm_decode_ctr_ex, plus detail -24 for a malformed
+// 'label2' (present but not a single float).
+long dfm_decode_ctr2_ex(const uint8_t* buf, const long* offsets,
+                        const long* lengths, long n, long field_size,
+                        float* labels, float* labels2, int32_t* ids,
+                        float* vals, long* err_detail) {
+  for (long i = 0; i < n; ++i) {
+    const uint8_t* p = buf + offsets[i];
+    long rc = parse_ctr_example(p, p + lengths[i], field_size, labels + i,
+                                ids + i * field_size, vals + i * field_size,
+                                labels2 + i);
+    if (rc != 0) {
+      if (err_detail) *err_detail = rc;
+      return -(100 + i);
+    }
+  }
+  return 0;
 }
 
 // Fused decode + shuffle scatter: decode record i straight into row dest[i]

@@ -16,7 +16,11 @@ closes the train→publish→serve loop. One device-owning process runs:
     (``utils.export.padded_predict``), so at most ``len(buckets)`` predict
     programs ever compile no matter what sizes traffic brings;
   * a **response demux** — padding stripped, per-request futures resolved
-    with per-request latency stamps (admission → resolution).
+    with per-request latency stamps (admission → resolution). The demux is
+    shape-agnostic: a single-output model resolves each future with probs
+    ``[n]`` (the historical wire shape, unchanged), a multitask artifact
+    with a ``{task_name: probs[n]}`` dict — whatever structure the predict
+    fn returns, rows are sliced per request.
 
 Hot swap rides the existing :class:`~deepfm_tpu.utils.export.LatestWatcher`:
 pass a watcher as ``predict_fn`` (or use :meth:`ServingEngine.serve_latest`)
@@ -76,8 +80,10 @@ class ServeFuture:
         self._event.set()
 
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
-        """Block for the probs ``[n]``; raises the predict error if the
-        flush failed, TimeoutError if not resolved in ``timeout``."""
+        """Block for the probs — ``[n]`` for single-output models,
+        ``{task_name: [n]}`` for multitask artifacts; raises the predict
+        error if the flush failed, TimeoutError if not resolved in
+        ``timeout``."""
         if not self._event.wait(timeout):
             raise TimeoutError(
                 f"request of {self.n} rows unresolved after {timeout}s")
@@ -252,8 +258,8 @@ class ServingEngine:
             vals = np.concatenate([f.vals for f in batch])
         bucket = self._export.next_bucket(rows, self.buckets)
         try:
-            probs = np.asarray(self._export.padded_predict(
-                self._fn, ids, vals, self.buckets)).reshape(-1)
+            out = self._export.padded_predict(
+                self._fn, ids, vals, self.buckets)
         except Exception as exc:  # noqa: BLE001 — forwarded per-request
             for fut in batch:
                 self.stats.record_request_failed()
@@ -261,11 +267,24 @@ class ServingEngine:
             return
         now = self._clock()
         off = 0
-        for fut in batch:
-            fut.set_result(probs[off:off + fut.n],
-                           latency_ms=1000.0 * (now - fut.t_enqueue))
-            off += fut.n
-            self.stats.record_request_done(fut.latency_ms)
+        if isinstance(out, dict):
+            # Multitask artifact: named per-task probability columns, each
+            # sliced per request — futures resolve with {task: probs[n]}.
+            named = {k: np.asarray(v) for k, v in out.items()}
+            for fut in batch:
+                fut.set_result(
+                    {k: v[off:off + fut.n] for k, v in named.items()},
+                    latency_ms=1000.0 * (now - fut.t_enqueue))
+                off += fut.n
+                self.stats.record_request_done(fut.latency_ms)
+        else:
+            # Single-output: the historical wire shape [n], bit-unchanged.
+            probs = np.asarray(out).reshape(-1)
+            for fut in batch:
+                fut.set_result(probs[off:off + fut.n],
+                               latency_ms=1000.0 * (now - fut.t_enqueue))
+                off += fut.n
+                self.stats.record_request_done(fut.latency_ms)
         self.stats.record_flush(rows, bucket, full=rows >= self.max_batch)
 
     # ---------------------------------------------------------- lifecycle

@@ -63,14 +63,19 @@ def pad_batch(batch: Dict[str, np.ndarray], bs: int) -> Dict[str, np.ndarray]:
             for k, v in batch.items()}
 
 
-def zero_batch(field_size: int, bs: int) -> Dict[str, np.ndarray]:
+def zero_batch(field_size: int, bs: int,
+               num_labels: int = 1) -> Dict[str, np.ndarray]:
     """All-zero batch with the canonical CTR schema — the single source of
-    the batch keys/dtypes for dummy (lockstep filler) batches."""
-    return {
+    the batch keys/dtypes for dummy (lockstep filler) batches. Multi-task
+    runs carry a second label column (``label2``)."""
+    batch = {
         "feat_ids": np.zeros((bs, field_size), np.int32),
         "feat_vals": np.zeros((bs, field_size), np.float32),
         "label": np.zeros((bs, 1), np.float32),
     }
+    if num_labels > 1:
+        batch["label2"] = np.zeros((bs, 1), np.float32)
+    return batch
 
 
 def _with_weight(batch: Dict[str, np.ndarray], bs: int) -> Dict[str, np.ndarray]:
@@ -93,6 +98,11 @@ class Trainer:
     def __init__(self, cfg: Config, mesh_info: Optional[mesh_lib.MeshInfo] = None):
         self.cfg = cfg
         self.model = get_model(cfg)
+        # Multi-task contract: the model emits [B, T] logits and owns the
+        # per-task loss combination; single-task models keep the legacy [B]
+        # path byte-for-byte (bit-exactness tests pin it).
+        self._task_names = tuple(getattr(self.model, "task_names", ("ctr",)))
+        self._multitask = len(self._task_names) > 1
         self.mesh_info = mesh_info if mesh_info is not None else mesh_lib.build_mesh(cfg)
         self.tx = opt_lib.build_optimizer(cfg, world_size=self.mesh_info.data_size)
         self._specs: Optional[Dict[str, Any]] = None
@@ -140,9 +150,15 @@ class Trainer:
     # ------------------------------------------------------------------
     # State creation / placement
     # ------------------------------------------------------------------
-    def init_state(self, seed: Optional[int] = None) -> TrainState:
+    def init_state(self, seed: Optional[int] = None, *,
+                   tiered: bool = True) -> TrainState:
         """Replicated-by-construction init: every process derives identical
-        params from the same seed (broadcast-hook analog)."""
+        params from the same seed (broadcast-hook analog).
+
+        ``tiered=False`` skips hot/cold adoption and returns the DENSE
+        state — the restore template for tiered runs, whose checkpoints are
+        written densified (``TieredEmbeddingRuntime.checkpoint_state``).
+        The caller restores into it, then calls ``self._tier.adopt``."""
         seed = self.cfg.seed if seed is None else seed
         rng = jax.random.PRNGKey(seed)
         k_init, k_state = jax.random.split(rng)
@@ -150,7 +166,7 @@ class Trainer:
         opt_state = self._init_opt_state(params)
         state = TrainState.create(params, opt_state, model_state, k_state)
         state = self._place(state)
-        if self._tier is not None:
+        if tiered and self._tier is not None:
             state = self._tier.adopt(state)
         return state
 
@@ -208,17 +224,29 @@ class Trainer:
     # ------------------------------------------------------------------
     def _per_example_loss(self, logits, labels):
         """Per-example loss by cfg.loss_type — the ONE place the loss_type
-        branch lives (train takes the mean; eval the weighted sum)."""
+        branch lives (train takes the mean; eval the weighted sum). Multi-
+        task models own their weighted per-task combination ([B,T] -> [B])."""
+        if self._multitask:
+            return self.model.per_example_loss(logits, labels)
         if self.cfg.loss_type == "log_loss":
             return optax.sigmoid_binary_cross_entropy(logits, labels)
         return jnp.square(jax.nn.sigmoid(logits) - labels)  # square_loss
+
+    def _batch_labels(self, batch):
+        """[B] labels (single-task, legacy path) or the [B,T] label matrix:
+        task 0 reads ``label``, task 1 the ``label2`` column."""
+        if not self._multitask:
+            return batch["label"].reshape(-1).astype(jnp.float32)
+        cols = [batch["label"].reshape(-1), batch["label2"].reshape(-1)]
+        return jnp.stack(cols[:len(self._task_names)],
+                         axis=1).astype(jnp.float32)
 
     def _loss_terms(self, params, model_state, batch, *, train, rng,
                     shard_axis, data_axis):
         logits, new_mstate = self.model.apply(
             params, model_state, batch["feat_ids"], batch["feat_vals"],
             train=train, rng=rng, shard_axis=shard_axis, data_axis=data_axis)
-        labels = batch["label"].reshape(-1).astype(jnp.float32)
+        labels = self._batch_labels(batch)
         xent = jnp.mean(self._per_example_loss(logits, labels))
         return logits, xent, new_mstate
 
@@ -295,7 +323,7 @@ class Trainer:
                 batch["feat_vals"], train=True, rng=rng,
                 shard_axis=None, data_axis=None,
                 emb_rows=rows, emb_plan=plan)
-            labels = batch["label"].reshape(-1).astype(jnp.float32)
+            labels = self._batch_labels(batch)
             xent = jnp.mean(self._per_example_loss(logits, labels))
             # Touched-rows-only L2 (deliberate deviation from dense L2 —
             # idle rows do not decay between touches; TUNING §2.11).
@@ -438,6 +466,31 @@ class Trainer:
             state.params, state.model_state, batch["feat_ids"],
             batch["feat_vals"], train=False, rng=None,
             shard_axis=shard_axis, data_axis=data_axis)
+        if self._multitask:
+            # Per-task dict accumulator: one psum-reducible histogram pair
+            # per named task; the combined weighted loss mirrors training.
+            labels_m = self._batch_labels(batch)
+            w = batch["weight"].reshape(-1).astype(jnp.float32)
+            per_ex = self._per_example_loss(logits, labels_m)
+            probs = self.model.probs_from_logits(logits)
+            deltas = {
+                name: metrics_lib.auc_update(
+                    metrics_lib.auc_init(self.cfg.auc_num_thresholds),
+                    probs[:, t], labels_m[:, t], w)
+                for t, name in enumerate(self._task_names)}
+            loss_total = jnp.sum(per_ex * w)
+            n = jnp.sum(w)
+            if data_axis is not None:
+                deltas = {name: metrics_lib.auc_psum(d, data_axis)
+                          for name, d in deltas.items()}
+                loss_total = jax.lax.psum(loss_total, data_axis)
+                n = jax.lax.psum(n, data_axis)
+            new_auc = {name: metrics_lib.auc_merge(auc_state[name], d)
+                       for name, d in deltas.items()}
+            new_loss = metrics_lib.MeanState(
+                total=loss_state.total + loss_total,
+                count=loss_state.count + n)
+            return (new_auc, new_loss)
         labels = batch["label"].reshape(-1).astype(jnp.float32)
         w = batch["weight"].reshape(-1).astype(jnp.float32)
         per_ex = self._per_example_loss(logits, labels)
@@ -511,6 +564,8 @@ class Trainer:
             state.params, state.model_state, batch["feat_ids"],
             batch["feat_vals"], train=False, rng=None,
             shard_axis=shard_axis, data_axis=data_axis)
+        if self._multitask:
+            return self.model.probs_from_logits(logits)  # [B, T]
         return jax.nn.sigmoid(logits)
 
     def _make_predict_step(self) -> Callable:
@@ -569,6 +624,9 @@ class Trainer:
                 "label": jax.ShapeDtypeStruct(
                     (self.cfg.batch_size, 1), jnp.float32),
             }
+            if self._multitask:
+                batch["label2"] = jax.ShapeDtypeStruct(
+                    (self.cfg.batch_size, 1), jnp.float32)
             eval_batch = dict(batch)
             eval_batch["weight"] = jax.ShapeDtypeStruct(
                 (self.cfg.batch_size, 1), jnp.float32)
@@ -1000,6 +1058,9 @@ class Trainer:
         for this pipeline, else a human-readable disqualifier (the caller
         warns and falls back to the staged path)."""
         cfg = self.cfg
+        if self._multitask:
+            return "multi-task run (the decoded-cache column set carries a "\
+                   "single label column)"
         if jax.process_count() > 1:
             return "multi-process run (device columns would need per-host "\
                    "record sharding)"
@@ -1266,7 +1327,8 @@ class Trainer:
 
     def _dummy_eval_batch(self, local_bs: int) -> Dict[str, np.ndarray]:
         """All-zero-weight batch: contributes nothing to AUC/loss."""
-        return {**zero_batch(self.cfg.field_size, local_bs),
+        return {**zero_batch(self.cfg.field_size, local_bs,
+                             num_labels=len(self._task_names)),
                 "weight": np.zeros((local_bs, 1), np.float32)}
 
     def evaluate(
@@ -1292,8 +1354,13 @@ class Trainer:
             raise ValueError(
                 f"global batch_size={cfg.batch_size} not divisible by "
                 f"process_count={world}")
-        acc = (metrics_lib.auc_init(cfg.auc_num_thresholds),
-               metrics_lib.mean_init())
+        if self._multitask:
+            acc = ({name: metrics_lib.auc_init(cfg.auc_num_thresholds)
+                    for name in self._task_names},
+                   metrics_lib.mean_init())
+        else:
+            acc = (metrics_lib.auc_init(cfg.auc_num_thresholds),
+                   metrics_lib.mean_init())
         acc = jax.device_put(acc)
         n = 0
         if world > 1:
@@ -1345,11 +1412,21 @@ class Trainer:
         if dispatched == 0:
             # Nothing ran anywhere (a rank that only fed dummies still has a
             # valid psum-merged global acc and must NOT zero it out).
-            return {"auc": 0.0, "loss": 0.0, "batches": 0.0,
-                    "examples_per_sec": 0.0,
-                    "examples_per_sec_steady": 0.0}
+            out = {"auc": 0.0, "loss": 0.0, "batches": 0.0,
+                   "examples_per_sec": 0.0,
+                   "examples_per_sec_steady": 0.0}
+            if self._multitask:
+                out.update({f"auc_{name}": 0.0 for name in self._task_names})
+            return out
         auc_state, loss_state = acc
-        auc = float(metrics_lib.auc_compute(auc_state))  # device sync
+        if self._multitask:
+            per_task_auc = {
+                name: float(metrics_lib.auc_compute(auc_state[name]))
+                for name in self._task_names}  # device sync
+            auc = per_task_auc[self._task_names[0]]
+        else:
+            per_task_auc = None
+            auc = float(metrics_lib.auc_compute(auc_state))  # device sync
         n_examples = float(loss_state.count)  # global weighted count
         # Wall includes the final device sync above, so the rate is
         # completed-on-device, not dispatch rate. First-call numbers include
@@ -1367,13 +1444,17 @@ class Trainer:
                 elapsed - first_elapsed)
         else:
             steady_eps = raw_eps
-        return {
+        out = {
             "auc": auc,
             "loss": float(metrics_lib.mean_compute(loss_state)),
             "batches": float(n),
             "examples_per_sec": raw_eps,
             "examples_per_sec_steady": steady_eps,
         }
+        if per_task_auc is not None:
+            # Named per-task AUCs alongside the headline (= first task).
+            out.update({f"auc_{name}": v for name, v in per_task_auc.items()})
+        return out
 
     def _local_rows(self, arr: jax.Array) -> np.ndarray:
         """This process's rows of a data-sharded output. Fully-addressable

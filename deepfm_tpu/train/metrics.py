@@ -67,7 +67,10 @@ def auc_compute(state: AucState) -> jnp.ndarray:
     fpr = fp / jnp.maximum(total_neg, 1.0)
     # ROC swept from threshold high->low is (fpr,tpr) increasing; integrate.
     auc = jnp.sum((fpr[:-1] - fpr[1:]) * (tpr[:-1] + tpr[1:]) * 0.5)
-    return jnp.where((total_pos > 0) & (total_neg > 0), auc, jnp.float32(0.0))
+    # Degenerate state (empty, or one class only): AUC is undefined — NaN,
+    # never a fake 0.0/0.5 that could silently gate a model promotion.
+    return jnp.where((total_pos > 0) & (total_neg > 0), auc,
+                     jnp.float32(jnp.nan))
 
 
 class MeanState(NamedTuple):
@@ -159,11 +162,51 @@ class WindowedAuc:
 
     def compute(self, histograms=None) -> float:
         """Windowed AUC (same trapezoidal estimator as :func:`auc_compute`);
-        0.0 while the window lacks both classes, mirroring the batch path."""
+        NaN while the window is empty or lacks one class, mirroring the
+        batch path — undefined is reported as undefined."""
         pos, neg = self.histograms() if histograms is None else histograms
         return float(auc_compute(AucState(
             pos=jnp.asarray(pos, jnp.float32),
             neg=jnp.asarray(neg, jnp.float32))))
+
+
+class WindowedAucDict:
+    """Per-task :class:`WindowedAuc`: one window per named task, one API.
+
+    ``update`` takes per-task probability/label COLUMNS ([B, T] in
+    ``task_names`` order, or [B] when there is one task); ``compute``
+    returns ``{task: windowed_auc}``. Each per-task window remains a
+    psum-reducible histogram pair (see :meth:`WindowedAuc.histograms`)."""
+
+    def __init__(self, task_names, window_steps: int, num_bins: int = 200):
+        self.task_names = tuple(task_names)
+        if not self.task_names:
+            raise ValueError("task_names must name at least one task")
+        self._windows = {t: WindowedAuc(window_steps, num_bins)
+                         for t in self.task_names}
+
+    def __getitem__(self, task: str) -> WindowedAuc:
+        return self._windows[task]
+
+    @property
+    def examples(self) -> int:
+        """Examples inside the window (identical across tasks — every
+        update feeds all columns)."""
+        return self._windows[self.task_names[0]].examples
+
+    def update(self, step: int, probs, labels) -> None:
+        import numpy as np
+        probs = np.asarray(probs)
+        labels = np.asarray(labels)
+        if probs.ndim == 1:
+            probs = probs[:, None]
+        if labels.ndim == 1:
+            labels = labels[:, None]
+        for i, t in enumerate(self.task_names):
+            self._windows[t].update(step, probs[:, i], labels[:, i])
+
+    def compute(self) -> Dict[str, float]:
+        return {t: w.compute() for t, w in self._windows.items()}
 
 
 def auc_numpy_reference(probs, labels) -> float:
@@ -188,6 +231,6 @@ def auc_numpy_reference(probs, labels) -> float:
     n_pos = labels.sum()
     n_neg = len(labels) - n_pos
     if n_pos == 0 or n_neg == 0:
-        return 0.0
+        return float("nan")  # undefined, matching auc_compute
     return float((ranks[labels == 1].sum() - n_pos * (n_pos + 1) / 2.0)
                  / (n_pos * n_neg))

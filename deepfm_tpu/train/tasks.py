@@ -206,6 +206,7 @@ def make_pipeline(cfg: Config, files: List[str], *, epochs: int = 1,
         input_workers=cfg.input_workers,
         stall_timeout_s=cfg.dispatch_timeout_s,
         verify_crc=cfg.verify_crc,
+        num_labels=cfg.num_tasks,
         **_fault_tolerance_kwargs(cfg),
     )
 
@@ -247,6 +248,7 @@ def make_streaming_pipeline(cfg: Config, files: List[str], *, epochs: int = 1,
         verify_crc=cfg.verify_crc,
         on_bad_record=cfg.on_bad_record,
         max_bad_records=cfg.max_bad_records,
+        num_labels=cfg.num_tasks,
         health=health,
     )
 
@@ -297,6 +299,7 @@ def make_online_pipeline(cfg: Config, train_dir: str, *, skip_batches: int = 0
         verify_crc=cfg.verify_crc,
         on_bad_record=cfg.on_bad_record,
         max_bad_records=cfg.max_bad_records,
+        num_labels=cfg.num_tasks,
         stream_label=f"<online:{train_dir}>",
         health=health,
     )
@@ -334,13 +337,25 @@ def _restore_or_init(trainer: Trainer, cfg: Config, require: bool,
     For train, the caller passes its manager in — manager construction runs
     a cross-process barrier, so every rank must build the same managers in
     the same order; an isdir-gated construction would race.
+
+    Hot/cold tiering: checkpoints are written DENSIFIED
+    (``TieredEmbeddingRuntime.checkpoint_state``), so the restore template
+    is the dense state (``init_state(tiered=False)``) and adoption into the
+    hot cache happens after the restore — the restored Adam moments seed
+    the cold tiers, making the round-trip bit-exact in both directions.
     """
-    state = trainer.init_state()
+    tier = getattr(trainer, "_tier", None)
+    state = (trainer.init_state(tiered=False) if tier is not None
+             else trainer.init_state())
+
+    def _adopted(s: TrainState) -> TrainState:
+        return tier.adopt(s) if tier is not None else s
+
     if not cfg.model_dir:
         if require:
             raise FileNotFoundError(
                 f"task '{cfg.task_type}' requires model_dir")
-        return state
+        return _adopted(state)
     if require and not fileio.isdir(cfg.model_dir):
         raise FileNotFoundError(
             f"task '{cfg.task_type}' needs a checkpoint in model_dir="
@@ -360,7 +375,23 @@ def _restore_or_init(trainer: Trainer, cfg: Config, require: bool,
     finally:
         if own:
             mgr.close()
-    return state
+    return _adopted(state)
+
+
+def _ckpt_state(trainer: Trainer, state: TrainState) -> TrainState:
+    """What goes INTO every checkpoint save: under hot/cold tiering the hot
+    window is flushed and the tables + Adam slots densified to full shape,
+    so the artifact restores bit-exactly into untiered (or differently
+    sized) configs. Dense runs pass through untouched."""
+    tier = getattr(trainer, "_tier", None)
+    return tier.checkpoint_state(state) if tier is not None else state
+
+
+def _servable_state(trainer: Trainer, state: TrainState) -> TrainState:
+    """Export-time analog of :func:`_ckpt_state`: the serving artifact
+    needs the full dense tables, not the hot window."""
+    tier = getattr(trainer, "_tier", None)
+    return tier.densified(state) if tier is not None else state
 
 
 def run(cfg: Config) -> Dict[str, float]:
@@ -440,6 +471,7 @@ def _make_throttled_eval_hook(trainer: Trainer, cfg: Config,
         result["mid_train_evals"] += 1
         result.update({"auc": ev["auc"], "eval_loss": ev["loss"],
                        "eval_examples_per_sec": ev["examples_per_sec"]})
+        result.update({k: v for k, v in ev.items() if k.startswith("auc_")})
         ulog.info(f"throttled eval @ step {int(state.step)}: "
                   f"auc={ev['auc']:.5f} loss={ev['loss']:.5f}")
         if on_eval is not None:
@@ -458,6 +490,9 @@ def _make_online_eval(trainer: Trainer, cfg: Config, va_files: List[str],
     import time as _time
 
     local_bs = _local_batch_size(cfg)
+    task_names = cfg.task_names
+    num_tasks = len(task_names)
+    weights = cfg.task_weight_values
 
     def evaluate(state: TrainState) -> Dict[str, float]:
         pipeline = _eval_pipeline(cfg, va_files)
@@ -470,25 +505,43 @@ def _make_online_eval(trainer: Trainer, cfg: Config, va_files: List[str],
             for batch in pipeline:
                 n = batch["label"].shape[0]
                 real_rows.append(n)
-                labels.append(np.asarray(batch["label"]).reshape(-1)[:n])
+                cols = [np.asarray(batch["label"]).reshape(-1)[:n]]
+                if num_tasks > 1:
+                    cols.append(
+                        np.asarray(batch["label2"]).reshape(-1)[:n])
+                labels.append(np.stack(cols, axis=1))
                 yield (pad_batch(batch, local_bs)  # pad tail, trim after
                        if n < local_bs else batch)
 
         for i, p in enumerate(trainer.predict(state, feed())):
-            probs.append(np.asarray(p).reshape(-1)[:real_rows[i]])
+            arr = np.asarray(p)
+            if arr.ndim == 1:
+                arr = arr[:, None]
+            probs.append(arr[:real_rows[i]])
         elapsed = max(_time.time() - t0, 1e-9)
         p = (np.concatenate(probs) if probs
-             else np.zeros((0,), np.float64)).astype(np.float64)
+             else np.zeros((0, num_tasks), np.float64)).astype(np.float64)
         y = (np.concatenate(labels) if labels
-             else np.zeros((0,), np.float64)).astype(np.float64)
+             else np.zeros((0, num_tasks), np.float64)).astype(np.float64)
         window.update(int(step_fn()), p, y)
         pc = np.clip(p, 1e-7, 1.0 - 1e-7)
-        loss = (float(-(y * np.log(pc)
-                        + (1.0 - y) * np.log1p(-pc)).mean())
-                if len(y) else 0.0)
-        return {"auc": window.compute(), "loss": loss,
-                "examples_per_sec": len(y) / elapsed,
-                "window_examples": float(window.examples)}
+        if len(y):
+            per_task = -(y * np.log(pc)
+                         + (1.0 - y) * np.log1p(-pc)).mean(axis=0)
+            loss = float(sum(w * per_task[t]
+                             for t, w in enumerate(weights)))
+        else:
+            loss = 0.0
+        auc = window.compute()
+        result = {"loss": loss,
+                  "examples_per_sec": len(y) / elapsed,
+                  "window_examples": float(window.examples)}
+        if isinstance(auc, dict):
+            result["auc"] = auc[task_names[0]]
+            result.update({f"auc_{t}": v for t, v in auc.items()})
+        else:
+            result["auc"] = auc
+        return result
 
     return evaluate
 
@@ -897,7 +950,7 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
         if mgr is not None:
             def ckpt_hook(s: TrainState, m) -> None:
                 if mgr.should_save(step_counter[0]):
-                    if mgr.save(step_counter[0], s):
+                    if mgr.save(step_counter[0], _ckpt_state(trainer, s)):
                         last_saved[0] = step_counter[0]
                         _write_resume_meta(
                             cfg.model_dir, _meta(step_counter[0], False))
@@ -957,7 +1010,7 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                 # An interval save may have just landed on this exact step
                 # (mgr.save dedups); the resume sidecar makes the mid-epoch
                 # position replay-exact on restart.
-                mgr.save(step, s, force=True)
+                mgr.save(step, _ckpt_state(trainer, s), force=True)
                 _write_resume_meta(cfg.model_dir, _meta(step, False))
             if online_stream[0] is not None:
                 online_stream[0].request_stop()  # wake a blocked poll wait
@@ -986,9 +1039,14 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
         online_eval_fn = None
         if (cfg.online_mode and va_files
                 and cfg.online_eval_window_steps > 0):
-            window = metrics_lib.WindowedAuc(
-                cfg.online_eval_window_steps,
-                num_bins=cfg.auc_num_thresholds)
+            if cfg.num_tasks > 1:
+                window = metrics_lib.WindowedAucDict(
+                    cfg.task_names, cfg.online_eval_window_steps,
+                    num_bins=cfg.auc_num_thresholds)
+            else:
+                window = metrics_lib.WindowedAuc(
+                    cfg.online_eval_window_steps,
+                    num_bins=cfg.auc_num_thresholds)
             online_eval_fn = _make_online_eval(
                 trainer, cfg, va_files, window, lambda: step_counter[0])
         if eval_throttled:
@@ -1044,6 +1102,8 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                     result.update({"auc": ev["auc"], "eval_loss": ev["loss"],
                                    "eval_examples_per_sec":
                                        ev["examples_per_sec"]})
+                    result.update({k: v for k, v in ev.items()
+                                   if k.startswith("auc_")})
                     if "window_examples" in ev:  # online windowed AUC
                         result["window_examples"] = ev["window_examples"]
                     _tb_eval(ev, state)
@@ -1092,6 +1152,8 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                         result.update({"auc": ev["auc"], "eval_loss": ev["loss"],
                                        "eval_examples_per_sec":
                                            ev["examples_per_sec"]})
+                        result.update({k: v for k, v in ev.items()
+                                       if k.startswith("auc_")})
                         _tb_eval(ev, state)
                 if va_files and eval_throttled:
                     # Final eval at completion (train_and_evaluate does one).
@@ -1101,6 +1163,8 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                     result.update({"auc": ev["auc"], "eval_loss": ev["loss"],
                                    "eval_examples_per_sec":
                                        ev["examples_per_sec"]})
+                    result.update({k: v for k, v in ev.items()
+                                   if k.startswith("auc_")})
                     _tb_eval(ev, state)
         finally:
             tracer.close()
@@ -1111,7 +1175,7 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                 online_stream[0] = None
         if mgr is not None:
             final_step = int(state.step)
-            mgr.save(final_step, state, force=True)
+            mgr.save(final_step, _ckpt_state(trainer, state), force=True)
             _write_resume_meta(cfg.model_dir, _meta(final_step, True))
         return state
 
@@ -1147,7 +1211,8 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
 
     if cfg.servable_model_dir and bootstrap.is_chief():
         out = fileio.join(cfg.servable_model_dir, str(int(state.step)))
-        export_lib.export_serving(trainer.model, state, cfg, out)
+        export_lib.export_serving(
+            trainer.model, _servable_state(trainer, state), cfg, out)
     result["steps"] = float(int(state.step))
     result["read_retries"] = float(health_totals.get("read_retries", 0))
     result["bad_records"] = float(health_totals.get("bad_records", 0))
@@ -1171,9 +1236,11 @@ def _interleave_rank_shards(gathered: np.ndarray, counts: np.ndarray
                             ) -> np.ndarray:
     """Reassemble global record order from per-rank record-sharded results:
     rank r held records r, r+world, r+2*world, ... so global index
-    ``i * world + r`` maps to ``gathered[r, i]``."""
-    world, _ = gathered.shape
-    out = np.empty(int(counts.sum()), dtype=gathered.dtype)
+    ``i * world + r`` maps to ``gathered[r, i]``. Trailing dims (per-task
+    probability columns) carry through unchanged."""
+    world = gathered.shape[0]
+    out = np.empty((int(counts.sum()),) + gathered.shape[2:],
+                   dtype=gathered.dtype)
     for r in range(world):
         n = int(counts[r])
         out[r:(n - 1) * world + r + 1:world] = gathered[r, :n]
@@ -1201,7 +1268,7 @@ def _task_infer(trainer: Trainer, cfg: Config) -> Dict[str, float]:
         seed=cfg.seed, shard=shard, prefetch_batches=cfg.prefetch_batches,
         use_native_decoder=cfg.use_native_decoder,
         reader_threads=cfg.reader_threads, verify_crc=cfg.verify_crc,
-        **_fault_tolerance_kwargs(cfg))
+        num_labels=cfg.num_tasks, **_fault_tolerance_kwargs(cfg))
 
     # Collectives inside predict_step require every process to run the same
     # number of rounds, but per-rank record counts can differ by one. Rather
@@ -1224,7 +1291,8 @@ def _task_infer(trainer: Trainer, cfg: Config) -> Dict[str, float]:
         from .loop import zero_batch  # noqa: PLC0415
 
         def make_dummy():
-            return zero_batch(cfg.field_size, local_bs)
+            return zero_batch(cfg.field_size, local_bs,
+                              num_labels=cfg.num_tasks)
 
         def feed():
             # Lockstep rounds keep every rank's fed-stream length identical
@@ -1256,11 +1324,14 @@ def _task_infer(trainer: Trainer, cfg: Config) -> Dict[str, float]:
             n = real_rows[i]
             n_local += n
             probs.append(p[:n])
+    # Single-task probs are [n]; multitask [n, T] (one column per task, in
+    # cfg.task_names order).
+    tail = (cfg.num_tasks,) if cfg.num_tasks > 1 else ()
     local = (np.concatenate(probs) if probs
-             else np.zeros((0,), np.float32)).astype(np.float32)
+             else np.zeros((0,) + tail, np.float32)).astype(np.float32)
 
     if world > 1:
-        padded = np.zeros(max(int(counts.max()), 1), np.float32)
+        padded = np.zeros((max(int(counts.max()), 1),) + tail, np.float32)
         padded[:len(local)] = local
         gathered = np.asarray(multihost_utils.process_allgather(padded))
         all_probs = _interleave_rank_shards(gathered, counts)
@@ -1270,8 +1341,11 @@ def _task_infer(trainer: Trainer, cfg: Config) -> Dict[str, float]:
     out_path = fileio.join(cfg.val_data_dir or cfg.data_dir, "pred.txt")
     if bootstrap.is_chief():
         with fileio.open_stream(out_path, "w") as f:
+            # One line per record (ref :447-449); multitask writes one
+            # space-separated column per task.
             for p in all_probs:
-                f.write(f"{float(p):.6f}\n")  # one prob per line (ref :447-449)
+                row = np.atleast_1d(p)
+                f.write(" ".join(f"{float(v):.6f}" for v in row) + "\n")
         ulog.info(f"wrote {len(all_probs)} predictions to {out_path}")
     return {"num_predictions": float(len(all_probs))}
 
@@ -1282,5 +1356,6 @@ def _task_export(trainer: Trainer, cfg: Config) -> Dict[str, float]:
     state = _restore_or_init(trainer, cfg, require=True)
     if bootstrap.is_chief():
         out = fileio.join(cfg.servable_model_dir, str(int(state.step)))
-        export_lib.export_serving(trainer.model, state, cfg, out)
+        export_lib.export_serving(
+            trainer.model, _servable_state(trainer, state), cfg, out)
     return {"step": float(int(state.step))}

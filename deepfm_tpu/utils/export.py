@@ -53,12 +53,25 @@ class ArtifactIncomplete(RuntimeError):
     crashed mid-write, or the caller raced an in-flight publish)."""
 
 
+def _task_names(model) -> Tuple[str, ...]:
+    return tuple(getattr(model, "task_names", ()) or ())
+
+
 def _serving_fn(model, cfg: Config) -> Callable:
+    """Single-task: ``probs`` float32[B] (the reference signature, kept
+    bit-for-bit). Multitask: ``{task_name: float32[B]}`` — one named
+    probability head per task, in the model's declared task order."""
+    names = _task_names(model)
+    multitask = len(names) > 1
+
     def serve(params, model_state, feat_ids, feat_vals):
         logits, _ = model.apply(
             params, model_state, feat_ids.astype(jnp.int32),
             feat_vals.astype(jnp.float32), train=False, rng=None,
             shard_axis=None, data_axis=None)
+        if multitask:
+            probs = model.probs_from_logits(logits)  # [B, T]
+            return {name: probs[:, t] for t, name in enumerate(names)}
         return jax.nn.sigmoid(logits)
     return serve
 
@@ -115,14 +128,18 @@ def export_serving(model, state, cfg: Config, out_dir: str) -> str:
     # file above).
     _export_tf_savedmodel(serve, params, model_state, cfg, out_dir)
 
-    # 4. Signature/config metadata.
+    # 4. Signature/config metadata. Single-task keeps the historical "prob"
+    # output name; multitask artifacts advertise one output per task name.
+    names = _task_names(model)
+    outputs = ({name: ["batch", "float32"] for name in names}
+               if len(names) > 1 else {"prob": ["batch", "float32"]})
     meta = {
         "signature": {
             "inputs": {
                 "feat_ids": ["batch", cfg.field_size, "int32"],
                 "feat_vals": ["batch", cfg.field_size, "float32"],
             },
-            "outputs": {"prob": ["batch", "float32"]},
+            "outputs": outputs,
         },
         "model": cfg.model,
         "config": cfg.to_dict(),
@@ -169,10 +186,14 @@ def _export_tf_savedmodel(serve: Callable, params, model_state, cfg: Config,
             with_gradient=False)
         module = tf.Module()
         module.model_variables = variables  # tracked -> variables shard
+        def _sig_out(feat_ids, feat_vals):
+            out = tf_fn(variables, tf.cast(feat_ids, tf.int32), feat_vals)
+            # Multitask serve fns already return a {task: probs} dict;
+            # single-task keeps the reference's "prob" key.
+            return out if isinstance(out, dict) else {"prob": out}
+
         module.f = tf.function(
-            lambda feat_ids, feat_vals: {
-                "prob": tf_fn(variables, tf.cast(feat_ids, tf.int32),
-                              feat_vals)},
+            _sig_out,
             input_signature=[
                 tf.TensorSpec([None, cfg.field_size], tf.int64,
                               name="feat_ids"),
@@ -252,12 +273,18 @@ def padded_predict(fn: Callable[[np.ndarray, np.ndarray], np.ndarray],
     n = int(feat_ids.shape[0])
     b = next_bucket(n, buckets)
     if b == n:
-        return np.asarray(fn(feat_ids, feat_vals))
+        out = fn(feat_ids, feat_vals)
+        if isinstance(out, dict):  # multitask: {task: probs}
+            return {k: np.asarray(v) for k, v in out.items()}
+        return np.asarray(out)
     ids = np.zeros((b,) + feat_ids.shape[1:], feat_ids.dtype)
     vals = np.zeros((b,) + feat_vals.shape[1:], feat_vals.dtype)
     ids[:n] = feat_ids
     vals[:n] = feat_vals
-    return np.asarray(fn(ids, vals))[:n]
+    out = fn(ids, vals)
+    if isinstance(out, dict):
+        return {k: np.asarray(v)[:n] for k, v in out.items()}
+    return np.asarray(out)[:n]
 
 
 class BucketedPredict:
@@ -318,9 +345,12 @@ def load_serving(artifact_dir: str, *,
             exported = jax_export.deserialize(f.read())
 
         def serve(feat_ids: np.ndarray, feat_vals: np.ndarray) -> np.ndarray:
-            return np.asarray(exported.call(
+            out = exported.call(
                 params, model_state, feat_ids.astype(np.int32),
-                feat_vals.astype(np.float32)))
+                feat_vals.astype(np.float32))
+            if isinstance(out, dict):  # multitask: {task: probs}
+                return {k: np.asarray(v) for k, v in out.items()}
+            return np.asarray(out)
     else:
         # Fallback: rebuild from config (params-only artifact).
         from ..models import get_model
@@ -328,7 +358,10 @@ def load_serving(artifact_dir: str, *,
         fn = jax.jit(_serving_fn(model, cfg))
 
         def serve(feat_ids: np.ndarray, feat_vals: np.ndarray) -> np.ndarray:
-            return np.asarray(fn(params, model_state, feat_ids, feat_vals))
+            out = fn(params, model_state, feat_ids, feat_vals)
+            if isinstance(out, dict):
+                return {k: np.asarray(v) for k, v in out.items()}
+            return np.asarray(out)
     if buckets is not None:
         return BucketedPredict(serve, buckets)
     return serve

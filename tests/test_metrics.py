@@ -59,10 +59,17 @@ def test_merge_is_additive():
     np.testing.assert_allclose(np.asarray(merged.neg), np.asarray(both.neg))
 
 
-def test_degenerate_single_class_is_zero():
+def test_degenerate_single_class_is_nan():
+    # All-positive (or all-negative) windows have no defined ranking metric:
+    # NaN, not a fake 0.5/0.0 that dashboards would average into real AUC.
     st = metrics.auc_update(
         metrics.auc_init(50), jnp.asarray([0.2, 0.8]), jnp.asarray([1.0, 1.0]))
-    assert float(metrics.auc_compute(st)) == 0.0
+    assert np.isnan(float(metrics.auc_compute(st)))
+    st = metrics.auc_update(
+        metrics.auc_init(50), jnp.asarray([0.2, 0.8]), jnp.asarray([0.0, 0.0]))
+    assert np.isnan(float(metrics.auc_compute(st)))
+    assert np.isnan(metrics.auc_numpy_reference(
+        np.array([0.2, 0.8]), np.array([1.0, 1.0])))
 
 
 def test_perfect_separation_is_one():
@@ -123,6 +130,52 @@ class TestWindowedAuc:
         assert abs(w.compute() - want) < 0.01
         assert w.examples == len(probs)
 
-    def test_empty_window_is_zero(self):
+    def test_empty_window_is_nan(self):
         w = metrics.WindowedAuc(window_steps=10)
-        assert w.compute() == 0.0 and w.examples == 0
+        assert np.isnan(w.compute()) and w.examples == 0
+
+    def test_one_class_window_is_nan(self):
+        w = metrics.WindowedAuc(window_steps=10)
+        w.update(1, np.array([0.2, 0.8]), np.array([1.0, 1.0]))
+        assert np.isnan(w.compute()) and w.examples == 2
+
+
+class TestWindowedAucDict:
+    """Per-task dict of sliding windows for multitask online eval."""
+
+    def test_per_task_matches_numpy_reference(self):
+        p1, l1 = _data(seed=20)
+        p2, l2 = _data(seed=21)
+        w = metrics.WindowedAucDict(("ctr", "cvr"), window_steps=100,
+                                    num_bins=400)
+        w.update(1, np.stack([p1, p2], axis=1), np.stack([l1, l2], axis=1))
+        got = w.compute()
+        assert set(got) == {"ctr", "cvr"}
+        assert abs(got["ctr"] - metrics.auc_numpy_reference(p1, l1)) < 0.005
+        assert abs(got["cvr"] - metrics.auc_numpy_reference(p2, l2)) < 0.005
+        assert w.examples == len(p1)
+
+    def test_single_column_update_broadcasts(self):
+        probs, labels = _data(seed=22)
+        w = metrics.WindowedAucDict(("ctr",), window_steps=100, num_bins=200)
+        w.update(1, probs, labels)  # 1-D accepted for a single task
+        ref = metrics.WindowedAuc(window_steps=100, num_bins=200)
+        ref.update(1, probs, labels)
+        assert abs(w.compute()["ctr"] - ref.compute()) < 1e-12
+
+    def test_degenerate_task_is_nan_others_fine(self):
+        p1, l1 = _data(seed=23)
+        w = metrics.WindowedAucDict(("ctr", "cvr"), window_steps=100,
+                                    num_bins=200)
+        # cvr column: all-zero labels (no conversion in the window).
+        w.update(1, np.stack([p1, p1], axis=1),
+                 np.stack([l1, np.zeros_like(l1)], axis=1))
+        got = w.compute()
+        assert not np.isnan(got["ctr"])
+        assert np.isnan(got["cvr"])
+
+    def test_empty_windows_are_nan(self):
+        w = metrics.WindowedAucDict(("ctr", "cvr"), window_steps=10)
+        got = w.compute()
+        assert np.isnan(got["ctr"]) and np.isnan(got["cvr"])
+        assert w.examples == 0

@@ -9,6 +9,7 @@ import pytest
 
 from deepfm_tpu.config import Config
 from deepfm_tpu.data import libsvm, pipeline
+from deepfm_tpu.models import registered_models
 from deepfm_tpu.parallel import mesh as mesh_lib
 from deepfm_tpu.train import Trainer, metrics
 
@@ -39,7 +40,20 @@ def _pipeline(cfg, files, epochs=1, shuffle=True):
         files, field_size=cfg.field_size, batch_size=cfg.batch_size,
         num_epochs=epochs, shuffle=shuffle, shuffle_files=shuffle,
         shuffle_buffer=cfg.shuffle_buffer, seed=cfg.seed,
-        use_native_decoder=False, prefetch_batches=0)
+        use_native_decoder=False, prefetch_batches=0,
+        num_labels=cfg.num_tasks)
+
+
+# Registry-driven zoo: every single-task graph plus one multi-task config,
+# so new registry entries inherit the distributed/checkpoint tests for free.
+_ZOO = registered_models() + ["mmoe"]
+
+
+def _zoo_cfg(model, **kw):
+    if model == "mmoe":
+        return _cfg(model="deepfm", tasks="ctr,cvr", multitask="mmoe",
+                    mmoe_experts=2, **kw)
+    return _cfg(model=model, **kw)
 
 
 class TestSingleDevice:
@@ -170,12 +184,32 @@ class TestDistributedParity:
             np.asarray(s8.model_state["bn"][0]["mean"]), rtol=1e-3, atol=1e-5)
         assert abs(ev1["loss"] - ev8["loss"]) < 1e-3
 
-    @pytest.mark.parametrize("model", ["widedeep", "dcnv2"])
+    @pytest.mark.parametrize("model", _ZOO)
     def test_model_zoo_distributed(self, data_files, model):
-        cfg = _cfg(model=model, mesh_data=4, mesh_model=2)
+        cfg = _zoo_cfg(model, mesh_data=4, mesh_model=2)
         tr, state, ev = self._run(cfg, data_files, steps=8)
         assert np.isfinite(ev["loss"])
         assert 0.0 <= ev["auc"] <= 1.0
+
+    @pytest.mark.parametrize("model", _ZOO)
+    def test_zoo_checkpoint_roundtrip(self, data_files, tmp_path, model):
+        """Save/restore must reproduce eval exactly for every zoo entry."""
+        from deepfm_tpu.utils import checkpoint as ckpt_lib
+        cfg = _zoo_cfg(model)
+        tr = Trainer(cfg)
+        state, _ = tr.fit(tr.init_state(), _pipeline(cfg, data_files),
+                          max_steps=4)
+        ev = tr.evaluate(state, _pipeline(cfg, data_files, shuffle=False))
+        d = str(tmp_path / "zoo")
+        with ckpt_lib.CheckpointManager(d) as mgr:
+            mgr.save(4, state)
+        tr2 = Trainer(cfg)
+        with ckpt_lib.CheckpointManager(d) as mgr:
+            restored = mgr.restore(tr2.init_state())
+        ev2 = tr2.evaluate(restored, _pipeline(cfg, data_files,
+                                               shuffle=False))
+        assert ev2["auc"] == pytest.approx(ev["auc"], abs=1e-6)
+        assert ev2["loss"] == pytest.approx(ev["loss"], abs=1e-6)
 
     @pytest.mark.mesh_bitexact
     def test_checkpoint_portable_across_meshes(self, data_files, tmp_path):
