@@ -273,29 +273,9 @@ def _model_flops_per_example(cfg) -> float:
     return 3.0 * (dnn + fm)
 
 
-# Dense bf16 peak FLOP/s per chip by device_kind (public spec sheets).
-# Matched by substring against jax's device_kind; unknown kinds (CPU,
-# future TPUs) yield a null MFU rather than a wrong one.
-_PEAK_FLOPS_BF16 = {
-    "v6e": 918e12, "v6 lite": 918e12,
-    "v5p": 459e12,
-    "v5e": 197e12, "v5 lite": 197e12,
-    "v4": 275e12,
-    "v3": 123e12,
-    "v2": 45e12,
-}
-
-
-def _device_peak_flops():
-    """(peak_flops_or_None, device_kind) for the first visible device."""
-    import jax
-    kind = jax.devices()[0].device_kind
-    low = kind.lower()
-    if "tpu" in low:
-        for key, peak in _PEAK_FLOPS_BF16.items():
-            if key in low:
-                return peak, kind
-    return None, kind
+# Peak-FLOPS tables and the MFU basis labels live in deepfm_tpu.utils.mfu
+# so bench.py and bench_multiprocess.py stamp the same in-band basis
+# (measured-device-peak | nominal-estimate | unavailable) on every MFU.
 
 
 def _bench_cfg(batch_size: int = 1024, mesh_data: int = 0,
@@ -813,20 +793,20 @@ def main() -> None:
 
     nominal_per_accel_baseline = 250_000.0 / 4.0
     # MFU from the device-only series (no transfer in the window): model
-    # FLOPs/example x device-only examples/sec/chip over the chip's dense
-    # bf16 peak. Null off-TPU or on an unrecognized device_kind. The tiny
-    # number it yields is the honest headline: DeepFM at batch 1024 is
-    # lookup/update-bound, so "fast" here means low step LATENCY, and MFU
-    # quantifies how far from a FLOP wall this workload runs.
+    # FLOPs/example x device-only examples/sec/chip over the device peak.
+    # mfu_basis says where that peak came from: the chip spec sheet
+    # (measured-device-peak), a labeled nominal host estimate on the CPU
+    # backend (nominal-estimate), or nowhere (unavailable, null MFU) —
+    # see BASELINE.md. The tiny number it yields is the honest headline:
+    # DeepFM at batch 1024 is lookup/update-bound, so "fast" here means
+    # low step LATENCY, and MFU quantifies distance from a FLOP wall.
+    from deepfm_tpu.utils import mfu as mfu_lib
     flops_per_example = _model_flops_per_example(cfg)
-    peak_flops, device_kind = _device_peak_flops()
     device_only_eps_per_chip = (
         cfg.batch_size / (r["device_only_ms_per_step"] / 1000.0)
         / max(r["devices"], 1))
-    device_only_mfu_pct = (
-        round(100.0 * flops_per_example * device_only_eps_per_chip
-              / peak_flops, 4)
-        if peak_flops else None)
+    device_only_mfu_pct, mfu_basis, device_kind = mfu_lib.mfu_pct(
+        flops_per_example, device_only_eps_per_chip)
     result = {
         "metric": "deepfm_criteo_train_throughput_per_chip",
         "value": round(r["per_chip_eps"], 1),
@@ -843,6 +823,7 @@ def main() -> None:
         "device_kind": device_kind,
         "model_flops_per_example": flops_per_example,
         "device_only_mfu_pct": device_only_mfu_pct,
+        "mfu_basis": mfu_basis,
         "host_series": host_series,
         "pallas_ab_device": pallas_ab,
         "device_resident": device_resident,

@@ -135,6 +135,22 @@ class Config:
     verify_crc: bool = False
     steps_per_loop: int = 8           # optimizer steps per host dispatch (lax.scan)
     transfer_ahead: int = 2           # host->device staging depth (batches ahead)
+    # Device staging slots (TUNING §2.13). 2 = double-buffered: the staging
+    # thread transfers dispatch k+1's superbatch into the free slot while
+    # the device computes dispatch k, fencing on slot reuse (transfer k
+    # blocks until dispatch k-2 completed ON device). 1 = single-buffered:
+    # every transfer fences on the previous dispatch's completion — H2D
+    # serializes with compute (the A/B baseline, and an HBM escape hatch
+    # when two staged superbatches don't fit). The trajectory is
+    # bit-identical either way; only timing moves.
+    staging_buffers: int = 2          # 1 | 2 device staging slots
+    # Gradient accumulation (TUNING §2.13): accumulate this many microbatch
+    # gradients (each a full --batch_size batch) before ONE optimizer
+    # apply — effective batch = batch_size * grad_accum_steps * data
+    # parallelism, at one microbatch of activation memory. state.step and
+    # every step-counted cadence (log/save/resume) keep counting
+    # MICROBATCHES; Adam's bias-correction count ticks once per apply.
+    grad_accum_steps: int = 1         # microbatches per optimizer apply
     # ---- fault tolerance (I/O layer; see README "Fault tolerance") ----
     on_bad_record: str = "raise"      # raise | skip corrupt/truncated records
     max_bad_records: int = 0          # skip budget when skipping (0 = unlimited)
@@ -309,6 +325,27 @@ class Config:
             raise ValueError("mesh_model must be >= 1")
         if self.steps_per_loop < 1:
             raise ValueError("steps_per_loop must be >= 1")
+        if self.staging_buffers not in (1, 2):
+            raise ValueError(
+                f"staging_buffers must be 1 or 2, got {self.staging_buffers}")
+        if self.grad_accum_steps < 1:
+            raise ValueError("grad_accum_steps must be >= 1")
+        if self.grad_accum_steps > 1:
+            if self.steps_per_loop % self.grad_accum_steps != 0:
+                raise ValueError(
+                    f"grad_accum_steps={self.grad_accum_steps} must divide "
+                    f"steps_per_loop={self.steps_per_loop} (each dispatch "
+                    "covers a whole number of accumulation groups)")
+            if self.device_dataset:
+                raise ValueError(
+                    "grad_accum_steps > 1 is not supported with "
+                    "device_dataset (the on-device gather path applies the "
+                    "optimizer per batch)")
+            if self.embedding_tiering != "off":
+                raise ValueError(
+                    "grad_accum_steps > 1 is not supported with "
+                    "embedding_tiering (the hot/cold planner transacts one "
+                    "batch per optimizer step)")
         if self.on_bad_record not in ("raise", "skip"):
             raise ValueError(
                 f"on_bad_record must be 'raise' or 'skip', "

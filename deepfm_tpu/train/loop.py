@@ -20,6 +20,9 @@ The fit loop feeds host batches via ``jax.make_array_from_process_local_data``
 from __future__ import annotations
 
 import math
+import os
+import queue
+import threading
 import time
 from typing import Any, Callable, Dict, Iterable, Iterator, Optional, Tuple
 
@@ -92,6 +95,92 @@ def _with_weight(batch: Dict[str, np.ndarray], bs: int) -> Dict[str, np.ndarray]
     return {**batch, "weight": w}
 
 
+def _staged_records(args) -> int:
+    """Record count of a staged transfer's host-side payload (batch dict or
+    list of batch dicts); 0 for layouts without a 'label' column (columnar
+    input-service rows) — the synthetic stall then leaves them alone."""
+    for a in args:
+        if isinstance(a, dict) and "label" in a:
+            return int(a["label"].shape[0])
+        if isinstance(a, (list, tuple)) and a and isinstance(a[0], dict):
+            return sum(int(b["label"].shape[0]) for b in a
+                       if isinstance(b, dict) and "label" in b)
+    return 0
+
+
+class _StagingRing:
+    """Bounded device staging area: at most ``n_slots`` superbatches may be
+    transferred ahead of the dispatches that consume them (TUNING §2.13).
+
+    The staging thread calls :meth:`put` around each host->device transfer;
+    the fit loop calls :meth:`retire` with a device value from each dispatch
+    (its readiness marks that dispatch complete ON DEVICE). Transfer j
+    fences on dispatch j - n_slots: with 2 slots dispatch k+1's transfer
+    runs while dispatch k computes (double buffering), with 1 slot every
+    transfer waits out the previous dispatch — H2D serializes with compute
+    (the A/B baseline, and the memory floor when two staged superbatches
+    don't fit). Purely a scheduling constraint: the trajectory is
+    bit-identical across slot counts.
+
+    Also the overlap instrument: ``transfer_s`` is time inside transfers,
+    ``wait_s`` time blocked on fences — ``overlap_fraction`` is the share
+    of staging time doing useful transfer work (1.0 = never fenced).
+    """
+
+    # Test/bench-only: inflate each transfer by N ns per staged record. On
+    # the CPU backend the host->device "transfer" is a core-local copy too
+    # cheap to measure, so the 1-vs-2-slot A/B has nothing to overlap; the
+    # synthetic stall stands in for a real PCIe/DMA leg (same spirit as the
+    # pipeline's DEEPFM_TPU_SYNTH_HOST_NS_PER_RECORD). Never set in
+    # production.
+    SYNTH_TRANSFER_ENV = "DEEPFM_TPU_SYNTH_TRANSFER_NS_PER_RECORD"
+
+    def __init__(self, n_slots: int):
+        self.n_slots = max(int(n_slots), 1)
+        self._fences: "queue.Queue[Any]" = queue.Queue()
+        self._closed = threading.Event()
+        self._staged = 0
+        self.transfer_s = 0.0
+        self.wait_s = 0.0
+        self._synth_ns = int(os.environ.get(self.SYNTH_TRANSFER_ENV, "0"))
+
+    def put(self, transfer: Callable[[], Any], n_records: int = 0) -> Any:
+        """Run one transfer under the slot discipline (staging thread)."""
+        self._staged += 1
+        if self._staged > self.n_slots:
+            t0 = time.time()
+            fence = None
+            # Poll against close so an abandoned fit (exception, early
+            # return) can never strand the staging thread on this queue.
+            while not self._closed.is_set():
+                try:
+                    fence = self._fences.get(timeout=0.1)
+                    break
+                except queue.Empty:
+                    continue
+            if fence is not None:
+                jax.block_until_ready(fence)
+            self.wait_s += time.time() - t0
+        t0 = time.time()
+        out = transfer()
+        if self._synth_ns and n_records:
+            time.sleep(self._synth_ns * n_records * 1e-9)
+        self.transfer_s += time.time() - t0
+        return out
+
+    def retire(self, fence: Any) -> None:
+        """Mark one dispatch's slot reusable once ``fence`` is ready
+        (fit thread; the fence is any device value the dispatch produced)."""
+        self._fences.put(fence)
+
+    def close(self) -> None:
+        self._closed.set()
+
+    def overlap_fraction(self) -> float:
+        total = self.transfer_s + self.wait_s
+        return 1.0 if total <= 0 else self.transfer_s / total
+
+
 class Trainer:
     """Builds and runs the compiled train/eval/predict step functions."""
 
@@ -146,6 +235,20 @@ class Trainer:
                     "fallback)")
             from ..data import hot_cold  # noqa: PLC0415
             self._tier = hot_cold.TieredEmbeddingRuntime(cfg, self.model)
+        # Gradient accumulation factor (config-validated; 1 = off). The
+        # scanned dispatch regroups its K microbatches into K//a optimizer
+        # applies plus K%a single-microbatch full steps for ragged tails.
+        self._accum = max(cfg.grad_accum_steps, 1)
+        # DCN-aware two-stage gradient reduction over 'data': derived from
+        # the mesh's host layout — None on single-host meshes (every
+        # virtual mesh included) and on layouts that don't decompose into
+        # equal per-host blocks. Tests override this seam to exercise the
+        # hierarchical program on a single-host virtual mesh.
+        self._hier_groups = mesh_lib.data_axis_host_groups(self.mesh_info)
+        # Active fit's device staging ring (slot fence + overlap timing);
+        # None outside fit so eval/predict transfers pass through untouched.
+        self._ring: Optional[_StagingRing] = None
+        self._grad_bytes_cache: Optional[int] = None
 
     # ------------------------------------------------------------------
     # State creation / placement
@@ -266,7 +369,7 @@ class Trainer:
             _, xent, new_mstate = self._loss_terms(
                 params, state.model_state, batch, train=True, rng=rng,
                 shard_axis=shard_axis, data_axis=data_axis)
-            if data_axis is not None:
+            if data_axis is not None and self._hier_groups is None:
                 # THE gradient sync point: the loss is made a *global*
                 # scalar (mean over the data axis); differentiating it
                 # under shard_map's replication-aware AD yields gradients
@@ -282,6 +385,17 @@ class Trainer:
 
         (_, (xent, l2, new_mstate)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
+        if data_axis is not None and self._hier_groups is not None:
+            # Hierarchical sync point (TUNING §2.13): the loss stayed
+            # per-shard above, so the raw grads carry no psum; average
+            # them intra-host then inter-host — the DCN stage moves 1/L
+            # of the flat-ring traffic (L = data rows per host). The l2
+            # component is shard-invariant over 'data', so averaging it
+            # too is a no-op up to reassociation.
+            grads = mesh_lib.hierarchical_pmean(
+                grads, data_axis, self._hier_groups,
+                self.mesh_info.data_size)
+            xent = jax.lax.pmean(xent, data_axis)  # metrics only
         # Structural guarantee: padded_vocab pad rows never receive a
         # gradient (they are zero already — unreachable ids, masked l2 —
         # so this is bit-neutral; the regression test pins it).
@@ -364,6 +478,164 @@ class Trainer:
             model_state=new_mstate)
         return new_state, {"loss": xent + l2, "xent": xent}
 
+    def _accum_step_impl(self, state: TrainState, batches, *, data_axis,
+                         shard_axis
+                         ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
+        """ONE optimizer apply over ``a`` stacked microbatches [a, B, ...].
+
+        The loss is the mean of per-microbatch mean losses — for equal-size
+        microbatches exactly the big-batch mean over a*B examples — so the
+        accumulated gradient equals the single big-batch gradient up to
+        float reassociation (the parity test pins the tolerance). The inner
+        scan re-walks the forward once per microbatch, so activation memory
+        peaks at ONE microbatch while the effective batch is
+        batch_size * a * data parallelism. ``state.step`` advances by ``a``
+        (it counts MICROBATCHES: resume bookkeeping equates steps with
+        batches consumed); the optimizer's count — Adam bias correction
+        included — ticks ONCE per apply.
+        """
+        if self.sparse_embed and data_axis is None and shard_axis is None:
+            return self._sparse_accum_step_impl(state, batches)
+        a = batches["label"].shape[0]
+        base_rng = jax.random.fold_in(state.rng, state.step)
+
+        def loss_fn(params):
+            def micro(carry, inp):
+                mstate, xent_sum = carry
+                i, batch = inp
+                rng = jax.random.fold_in(base_rng, i)
+                if data_axis is not None:
+                    rng = jax.random.fold_in(
+                        rng, jax.lax.axis_index(data_axis))
+                logits, new_mstate = self.model.apply(
+                    params, mstate, batch["feat_ids"], batch["feat_vals"],
+                    train=True, rng=rng, shard_axis=shard_axis,
+                    data_axis=data_axis)
+                labels = self._batch_labels(batch)
+                xent = jnp.mean(self._per_example_loss(logits, labels))
+                return (new_mstate, xent_sum + xent), None
+
+            (new_mstate, xent_sum), _ = jax.lax.scan(
+                micro, (state.model_state, jnp.zeros((), jnp.float32)),
+                (jnp.arange(a), batches))
+            xent = xent_sum / a
+            if data_axis is not None and self._hier_groups is None:
+                xent = jax.lax.pmean(xent, data_axis)
+            # L2 charged once per APPLY, not per microbatch — matching the
+            # equivalent big-batch step, where it also appears once.
+            l2 = self.model.l2_loss(params)
+            if shard_axis is not None:
+                l2 = jax.lax.psum(l2, shard_axis)
+            return xent + l2, (xent, l2, new_mstate)
+
+        (_, (xent, l2, new_mstate)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        if data_axis is not None and self._hier_groups is not None:
+            grads = mesh_lib.hierarchical_pmean(
+                grads, data_axis, self._hier_groups,
+                self.mesh_info.data_size)
+            xent = jax.lax.pmean(xent, data_axis)  # metrics only
+        grads = {**grads, **{
+            n: self.model.emb.mask_pad_grads(grads[n], axis_name=shard_axis)
+            for n in self._embed_names}}
+        updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + a, params=new_params, opt_state=new_opt,
+            model_state=new_mstate)
+        return new_state, {"loss": xent + l2, "xent": xent}
+
+    def _sparse_accum_step_impl(self, state: TrainState, batches
+                                ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
+        """Sparse-update accumulation: ONE merged plan across the group.
+
+        The group's a*B batches of ids dedup into a single PlanEntry per
+        table (``make_plan`` over the flattened group — the same machinery
+        as the per-batch plan), so the touched-rows gradient leaf is
+        gathered ONCE; each microbatch forward reads it through its [B, F]
+        slice of the shared inverse index, and AD accumulates the
+        per-microbatch cotangents into the same [U] row slots
+        automatically. One ``sparse_adam_rows`` apply per group (count
+        ticks once), touched-row L2 charged once per apply.
+        """
+        emb = self.model.emb
+        a, bsz = batches["feat_ids"].shape[:2]
+        base_rng = jax.random.fold_in(state.rng, state.step)
+        ids_flat = batches["feat_ids"].reshape(
+            (a * bsz,) + batches["feat_ids"].shape[2:])
+        plan = emb.sparse_plan(ids_flat)
+        # Per-microbatch plan views: merged uids, inverse index (and the
+        # hashed-mode position mask) sliced back to [B, F] for the scan.
+        inv_stack = {key: e.inv.reshape((a, bsz) + e.inv.shape[1:])
+                     for key, e in plan.items()}
+        mask_stack = {key: e.mask.reshape((a, bsz) + e.mask.shape[1:])
+                      for key, e in plan.items() if e.mask is not None}
+        rows0 = {n: emb.gather_rows(state.params[n], plan)
+                 for n in self._embed_names}
+        rest0 = {k: v for k, v in state.params.items()
+                 if k not in self._embed_names}
+
+        def loss_fn(diff):
+            rows, rest = diff
+            params = {**rest,
+                      **{n: state.params[n] for n in self._embed_names}}
+
+            def micro(carry, inp):
+                mstate, xent_sum = carry
+                i, batch, inv_i, mask_i = inp
+                plan_i = {key: e._replace(inv=inv_i[key],
+                                          mask=mask_i.get(key))
+                          for key, e in plan.items()}
+                rng = jax.random.fold_in(base_rng, i)
+                logits, new_mstate = self.model.apply(
+                    params, mstate, batch["feat_ids"], batch["feat_vals"],
+                    train=True, rng=rng, shard_axis=None, data_axis=None,
+                    emb_rows=rows, emb_plan=plan_i)
+                labels = self._batch_labels(batch)
+                xent = jnp.mean(self._per_example_loss(logits, labels))
+                return (new_mstate, xent_sum + xent), None
+
+            (new_mstate, xent_sum), _ = jax.lax.scan(
+                micro, (state.model_state, jnp.zeros((), jnp.float32)),
+                (jnp.arange(a), batches, inv_stack, mask_stack))
+            xent = xent_sum / a
+            l2 = self.model.l2_loss(params, emb_rows=rows, emb_plan=plan)
+            return xent + l2, (xent, l2, new_mstate)
+
+        (_, (xent, l2, new_mstate)), (g_rows, g_rest) = jax.value_and_grad(
+            loss_fn, has_aux=True)((rows0, rest0))
+
+        opt = state.opt_state
+        upd_rest, new_base = self.tx.update(g_rest, opt["base"], rest0)
+        new_rest = optax.apply_updates(rest0, upd_rest)
+        count = opt["count"] + 1
+        new_params = dict(new_rest)
+        new_embed = {}
+        for name in self._embed_names:
+            tabs = emb.tables(state.params[name])
+            new_tabs: Dict[str, jax.Array] = {}
+            new_opt_t: Dict[str, Any] = {}
+            for key, e in plan.items():
+                oe = opt["embed"][name][key]
+                new_rows, new_m, new_v = opt_lib.sparse_adam_rows(
+                    rows0[name][key], g_rows[name][key],
+                    emb_ops.gather_rows(oe.m, e),
+                    emb_ops.gather_rows(oe.v, e),
+                    emb_ops.gather_rows(oe.tau, e),
+                    count, lr=self._sparse_lr)
+                new_tabs[key] = emb_ops.scatter_rows(tabs[key], e, new_rows)
+                new_opt_t[key] = opt_lib.EmbedAdamEntry(
+                    m=emb_ops.scatter_rows(oe.m, e, new_m),
+                    v=emb_ops.scatter_rows(oe.v, e, new_v),
+                    tau=oe.tau.at[e.uids].set(count))
+            new_params[name] = emb.from_tables(new_tabs)
+            new_embed[name] = new_opt_t
+        new_opt = {"base": new_base, "embed": new_embed, "count": count}
+        new_state = state.replace(
+            step=state.step + a, params=new_params, opt_state=new_opt,
+            model_state=new_mstate)
+        return new_state, {"loss": xent + l2, "xent": xent}
+
     def _make_train_step(self) -> Callable:
         mi = self.mesh_info
         shard_axis = mi.model_axis if mi.model_size > 1 else None
@@ -382,7 +654,9 @@ class Trainer:
                 step, mesh=mi.mesh,
                 in_specs=(specs["state"], specs["batch"]),
                 out_specs=(specs["state"], P()),
-                check_vma=True),
+                # Grouped psums defeat static replication inference; the
+                # hierarchical program opts out of the check.
+                check_vma=self._hier_groups is None),
             donate_argnums=donate)
 
     def _make_train_multi_step(self) -> Callable:
@@ -391,16 +665,47 @@ class Trainer:
         per shape). Bit-identical to K sequential train_step calls (same rng
         folding, same update order) but amortizes the per-step host dispatch
         and host->device transfer overhead — the dominant e2e cost on a
-        single-core host (see README Performance)."""
+        single-core host (see README Performance).
+
+        Under ``--grad_accum_steps a`` > 1 the K scanned microbatches
+        regroup at trace time into K//a accumulated optimizer applies
+        (``_accum_step_impl``) plus K%a single-microbatch FULL optimizer
+        steps for a ragged tail group — a tail never stalls on a partial
+        accumulation group. ``state.step`` still counts microbatches either
+        way (resume bookkeeping equates steps with batches consumed)."""
         mi = self.mesh_info
         shard_axis = mi.model_axis if mi.model_size > 1 else None
         data_axis = mi.data_axis
+        a = self._accum
 
         def multi(state: TrainState, batches):
             def body(st, batch):
                 new_st, m = self._step_impl(
                     st, batch, data_axis=data_axis, shard_axis=shard_axis)
                 return new_st, jnp.stack((m["loss"], m["xent"]))
+
+            if a > 1:
+                k_steps = batches["label"].shape[0]
+                n_macro, left = divmod(k_steps, a)
+                ms = None
+                if n_macro:
+                    groups = jax.tree.map(
+                        lambda x: x[:n_macro * a].reshape(
+                            (n_macro, a) + x.shape[1:]), batches)
+
+                    def macro_body(st, group):
+                        new_st, m = self._accum_step_impl(
+                            st, group, data_axis=data_axis,
+                            shard_axis=shard_axis)
+                        return new_st, jnp.stack((m["loss"], m["xent"]))
+
+                    state, ms = jax.lax.scan(macro_body, state, groups)
+                if left:
+                    tail = jax.tree.map(lambda x: x[k_steps - left:], batches)
+                    state, ms_tail = jax.lax.scan(body, state, tail)
+                    ms = ms_tail if ms is None else jnp.concatenate(
+                        [ms, ms_tail])
+                return state, {"loss": ms[-1, 0], "xent": ms[-1, 1]}
             state2, ms = jax.lax.scan(body, state, batches)
             # Last-step metrics: matches what a sequential loop would report.
             return state2, {"loss": ms[-1, 0], "xent": ms[-1, 1]}
@@ -417,7 +722,7 @@ class Trainer:
                 multi, mesh=mi.mesh,
                 in_specs=(specs["state"], sb_specs),
                 out_specs=(specs["state"], P()),
-                check_vma=True),
+                check_vma=self._hier_groups is None),
             donate_argnums=donate)
 
     @property
@@ -676,6 +981,27 @@ class Trainer:
             self._predict_multi_step = self._make_predict_multi_step()
         return self._predict_multi_step
 
+    def _staged_put(self, put: Callable, *args) -> Any:
+        """Route a staging-thread host->device transfer through the active
+        fit's staging ring (slot fence + transfer/wait timing). Identity
+        passthrough outside fit, so eval/predict transfers are untouched."""
+        ring = self._ring
+        if ring is None:
+            return put(*args)
+        return ring.put(lambda: put(*args), _staged_records(args))
+
+    def _grad_payload_bytes(self) -> int:
+        """Analytic per-device payload of ONE gradient reduce over 'data'
+        (row-sharded embedding leaves count 1/model_size; see
+        mesh.grad_payload_bytes). Computed once from abstract shapes."""
+        if self._grad_bytes_cache is None:
+            abstract = jax.eval_shape(
+                lambda: self._abstract_state_for_specs())
+            self._grad_bytes_cache = mesh_lib.grad_payload_bytes(
+                abstract.params, self._embed_names,
+                self.mesh_info.model_size)
+        return self._grad_bytes_cache
+
     def _stage(self, batches: Iterable[Dict[str, np.ndarray]], k: int,
                depth: int):
         """Group host batches into K-step superbatches and move them to device
@@ -693,9 +1019,10 @@ class Trainer:
             if sb_iter is not None and k > 1:
                 for rows, m, n_ex in sb_iter(k):
                     if m == 1:
-                        yield self.put_batch(rows), 1, n_ex
+                        yield self._staged_put(self.put_batch, rows), 1, n_ex
                     else:
-                        yield self.put_superbatch_rows(rows, m), m, n_ex
+                        yield self._staged_put(
+                            self.put_superbatch_rows, rows, m), m, n_ex
                 return
             group = []
             for b in batches:
@@ -703,12 +1030,15 @@ class Trainer:
                 if len(group) == k:
                     n_ex = sum(g["label"].shape[0] for g in group)
                     if k == 1:
-                        yield self.put_batch(group[0]), 1, n_ex
+                        yield self._staged_put(
+                            self.put_batch, group[0]), 1, n_ex
                     else:
-                        yield self.put_superbatch(group), k, n_ex
+                        yield self._staged_put(
+                            self.put_superbatch, group), k, n_ex
                     group = []
             for b in group:
-                yield self.put_batch(b), 1, b["label"].shape[0]
+                yield (self._staged_put(self.put_batch, b), 1,
+                       b["label"].shape[0])
 
         if depth <= 0:
             return gen()
@@ -730,8 +1060,9 @@ class Trainer:
             n_ex = sum(g["label"].shape[0] for g in group)
             remapped = self._tier.plan_group(group)
             if len(remapped) == 1:
-                return self.put_batch(remapped[0]), 1, n_ex
-            return self.put_superbatch(remapped), len(remapped), n_ex
+                return self._staged_put(self.put_batch, remapped[0]), 1, n_ex
+            return (self._staged_put(self.put_superbatch, remapped),
+                    len(remapped), n_ex)
 
         def gen():
             group = []
@@ -780,8 +1111,10 @@ class Trainer:
                     group = list(itertools.islice(it, k))
                     staged = None
                     if len(group) == k:
-                        staged = (self.put_superbatch(group) if k > 1
-                                  else self.put_batch(group[0]))
+                        staged = (self._staged_put(self.put_superbatch, group)
+                                  if k > 1
+                                  else self._staged_put(
+                                      self.put_batch, group[0]))
                     yield staged, group
                     if len(group) < k:
                         return
@@ -919,6 +1252,11 @@ class Trainer:
             import itertools  # noqa: PLC0415
             batches = itertools.islice(iter(batches), max_steps)
         depth = cfg.transfer_ahead
+        # Device staging ring: every staging-thread transfer below routes
+        # through it (via _staged_put), fencing on slot reuse — 2 slots =
+        # transfer/compute overlap, 1 slot = serialized A/B baseline.
+        ring = _StagingRing(cfg.staging_buffers)
+        self._ring = ring
         if self._tier is not None:
             # Hot/cold tiering: plan + prefetch + slot remap on the staging
             # thread (single-process single-device by construction).
@@ -942,6 +1280,7 @@ class Trainer:
         m: Dict[str, Any] = {}
         prev_state: Optional[TrainState] = None
         meter = prof_lib.ThroughputMeter()
+        comm_applies = 0
         try:
             for dev_batch, steps_done, local_ex in staged_iter:
                 if self._tier is not None:
@@ -958,6 +1297,12 @@ class Trainer:
                     state, m = self.train_step(state, dev_batch)
                 else:
                     state, m = self.multi_step(state, dev_batch)
+                # Slot fence + comms accounting BEFORE the guard verdict: a
+                # skipped dispatch still occupied its staging slot and its
+                # collectives still crossed the fabric.
+                ring.retire(m["loss"])
+                comm_applies += (steps_done // self._accum
+                                 + steps_done % self._accum)
                 if guard_active:
                     verdict = self._guard_verdict(guard, state, m)
                     if verdict == "skip":
@@ -1011,6 +1356,10 @@ class Trainer:
         finally:
             if watchdog is not None:
                 watchdog.stop()
+            # Unblock a staging thread parked on a slot fence before closing
+            # the generator (close joins the prefetch thread).
+            ring.close()
+            self._ring = None
             # A mid-loop exception (rollback, preemption, abort) abandons the
             # staging generator; close it so prefetch threads, input-service
             # workers and file handles release before any retry attempt.
@@ -1027,6 +1376,17 @@ class Trainer:
             last_loss = float(m["loss"])
         out = {"loss": last_loss, "steps": float(n_steps)}
         out.update({k_: v for k_, v in meter.summary().items() if k_ != "steps"})
+        out["staging_overlap_fraction"] = ring.overlap_fraction()
+        out["staging_transfer_s"] = ring.transfer_s
+        out["staging_wait_s"] = ring.wait_s
+        if self.mesh_info.data_size > 1 and comm_applies:
+            # Analytic comms volume of the gradient sync (the bench's
+            # comms-per-example column): applies x per-apply payload.
+            out["collective_applies"] = float(comm_applies)
+            out["collective_bytes"] = float(
+                comm_applies * self._grad_payload_bytes())
+            out["collective_strategy"] = (
+                "hierarchical" if self._hier_groups is not None else "flat")
         return state, out
 
     # ------------------------------------------------------------------

@@ -651,11 +651,17 @@ def _consumption_layout(cfg: Config) -> List[int]:
     # with its own admission order), so a resume across the flag must never
     # trust a prior skip count — the list-LENGTH change guarantees that for
     # sidecars written before the flag existed too.
+    # grad_accum_steps does NOT change which batches a step count covers
+    # (state.step counts microbatches), but it changes which optimizer
+    # trajectory produced the checkpoint, so a resume across the flag falls
+    # back to epoch-replay via the list-LENGTH change rather than splicing
+    # two different accumulation regimes mid-epoch.
     return [2, jax.process_count(), cfg.steps_per_loop,
             int(cfg.use_native_decoder), cfg.batch_size,
             cfg.shuffle_buffer, cfg.seed, int(cfg.drop_remainder),
             int(cfg.shuffle_files), cache_lib.MODES.index(cfg.decoded_cache),
-            int(cfg.native_assembly), int(cfg.online_mode)]
+            int(cfg.native_assembly), int(cfg.online_mode),
+            cfg.grad_accum_steps]
 
 
 def _resume_position(cfg: Config, restored_step: int,
@@ -1081,6 +1087,9 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                     result["loss"] = fit_m["loss"]
                     result["examples_per_sec"] = fit_m.get(
                         "examples_per_sec", 0.0)
+                    result.update(
+                        {k: v for k, v in fit_m.items()
+                         if k.startswith(("staging_", "collective_"))})
                 if publisher is not None:
                     # Stream ended (idle timeout / stop): force one final
                     # publish at the terminal step. Deterministic — both an
@@ -1134,6 +1143,9 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                         result["loss"] = fit_m["loss"]
                         result["examples_per_sec"] = fit_m.get(
                             "examples_per_sec", 0.0)
+                        result.update(
+                            {k: v for k, v in fit_m.items()
+                             if k.startswith(("staging_", "collective_"))})
                     if (mgr is not None and last_saved[0] == step_counter[0]
                             and epoch + 1 < cfg.num_epochs):
                         # A checkpoint landed exactly on this epoch's last

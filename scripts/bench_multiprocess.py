@@ -1,34 +1,49 @@
 #!/usr/bin/env python
-"""Measure multi-process transfer/compute overlap (TUNING.md §4 evidence).
+"""Per-device-count scaling + overlap curve (SCALING_r01.json evidence).
 
-Round 4 and earlier forced ``transfer_ahead=0`` under ``world > 1`` —
-host->device staging serialized with step dispatch — because background
-staging would have interleaved collectives nondeterministically across
-ranks. Round 5 restored the overlap (``Trainer._stage_multiprocess``:
-process-local transfers on a staging thread, ALL collectives on the main
-thread). This script measures the before/after on the same 2-process
-topology the distributed tests use: a real ``jax.distributed`` rendezvous
-of 2 OS processes on the CPU backend, training the reference-shaped model.
+For each device count N this script runs the reference-shaped trainer
+twice per trial — ``--staging_buffers 1`` (each dispatch waits for its
+own host->device transfer) vs ``--staging_buffers 2`` (dispatch k+1's
+transfer overlaps dispatch k's compute) — interleaved A/B so host
+weather hits both variants equally, best-of-N wins (same methodology as
+bench.py / BASELINE.md). Each row of the emitted curve carries:
 
-``--transfer_ahead 0`` reproduces the old serialized behavior;
-``--transfer_ahead 2`` (the default) is the overlapped path. Trials are
-interleaved (A,B,A,B,...) so host weather hits both variants equally;
-best-of-N wins (same methodology as bench.py / BASELINE.md).
+- ``examples_per_sec`` (double-buffered) and ``serialized_eps``
+  (single-buffered), plus their ratio ``overlap_speedup`` and the
+  trainer's measured ``overlap_fraction`` (transfer time hidden behind
+  device compute / total transfer time);
+- ``mfu_pct`` with an in-band ``mfu_basis`` label
+  (measured-device-peak | nominal-estimate | unavailable — see
+  deepfm_tpu/utils/mfu.py and BASELINE.md);
+- ``topology_kind``: ``real-devices`` when N real accelerator chips ran
+  the mesh, ``virtual-mesh-timeslice`` when N virtual XLA CPU devices
+  time-sliced this host's core(s);
+- ``scaling_efficiency`` = eps(N) / (N * eps(1)) — REFUSED (null, with
+  the reason in-band) for time-sliced topologies, where the ratio would
+  measure time-slicing overhead and not hardware scaling.
 
-Usage: python scripts/bench_multiprocess.py [--trials 3] [--quick]
-Prints one JSON line: {"serialized_eps": ..., "overlapped_eps": ...,
-"overlap_speedup": ...}.
+Device counts > 1 run as ONE process over a virtual (or real) mesh; the
+legacy 2-process ``jax.distributed`` rendezvous is still available via
+``--multiprocess`` for jaxlib builds with CPU cross-process collectives.
 
-``--inflate-host-ns N`` adds a synthetic N ns/record stall to the host
-emission path of BOTH variants (a GIL-releasing sleep in the pipeline
-drain, via the DEEPFM_TPU_SYNTH_HOST_NS_PER_RECORD env var). On a 1-core
-host the un-inflated A/B is usually a wash — the CPU backend's "device"
-step and the host pipeline time-slice the same core, so there is nothing
-to overlap — but a sleep yields the core the way a real TPU dispatch
-does, so the overlapped variant hides the synthetic host cost behind the
-(time-sliced) step work and the speedup > 1 demonstrates the staging
-thread actually overlaps. This is a plumbing demonstration, not a
-throughput claim.
+``--inflate-host-ns N`` adds a synthetic N ns/record stall to the
+host->device TRANSFER leg of BOTH variants (a GIL-releasing sleep inside
+the staging ring's timed transfer section, via the
+DEEPFM_TPU_SYNTH_TRANSFER_NS_PER_RECORD env var) and pins
+``--prefetch_batches 0`` so the staging ring is the only overlap
+mechanism under test. On the CPU backend the real transfer is a
+core-local copy too cheap to measure, so the un-inflated A/B is a wash;
+the stall stands in for a real PCIe/DMA leg. The double-buffered
+variant hides it behind the previous dispatch's compute (its fence is
+one slot older) while the single-buffered variant serializes it, so
+speedup > 1 demonstrates the ring overlaps. That is a plumbing
+demonstration, not a throughput claim (and exactly why
+scaling_efficiency stays null here).
+
+Usage:
+  python scripts/bench_multiprocess.py [--device-counts 1,2] [--trials 2]
+      [--quick] [--inflate-host-ns 3000] [--out SCALING_r01.json]
+Prints the result JSON and writes it to --out.
 """
 
 import argparse
@@ -38,6 +53,7 @@ import socket
 import subprocess
 import sys
 import tempfile
+import types
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -51,6 +67,9 @@ from deepfm_tpu.launch import main
 sys.exit(main(sys.argv[1:]))
 """
 
+TIMESLICE = "virtual-mesh-timeslice"
+REAL = "real-devices"
+
 
 def _free_port() -> int:
     with socket.socket() as s:
@@ -58,27 +77,52 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def run_once(data_dir: str, model_dir: str, transfer_ahead: int,
-             epochs: int, inflate_host_ns: int = 0,
-             world: int = 2) -> float:
-    """One training run (``world`` processes); returns rank-0
-    examples_per_sec. ``world=1`` skips the jax.distributed rendezvous
-    entirely — the only topology that runs on jaxlib builds whose CPU
-    backend lacks cross-process collectives."""
+def _topology() -> tuple:
+    """(topology_kind, device_kind) for the devices the children will use.
+
+    The child runs force JAX_PLATFORMS=cpu and split the host into N
+    virtual XLA devices whenever the parent itself has no accelerator —
+    that is a time-sliced topology, never a scaling claim.
+    """
+    import jax
+    dev = jax.devices()[0]
+    if dev.platform == "cpu":
+        return TIMESLICE, dev.device_kind
+    return REAL, dev.device_kind
+
+
+def _flops_per_example() -> float:
+    """Analytic FLOPs/example at the bench shape (bench.py's inventory)."""
+    from bench import _model_flops_per_example
+    return _model_flops_per_example(types.SimpleNamespace(
+        deep_layers="128,64,32", field_size=39, embedding_size=32))
+
+
+def run_once(data_dir: str, model_dir: str, staging_buffers: int,
+             epochs: int, n_devices: int, inflate_host_ns: int = 0,
+             multiprocess: bool = False) -> dict:
+    """One training run; returns rank-0's result JSON (examples_per_sec,
+    staging_overlap_fraction, ...). Single-process mode meshes
+    ``n_devices`` virtual (or real) devices; ``multiprocess`` spawns a
+    real 2-process jax.distributed rendezvous instead — the only mode
+    that exercises cross-process collectives, and unavailable on jaxlib
+    builds whose CPU backend lacks them."""
+    world = 2 if multiprocess else 1
     env = dict(
         os.environ,
         JAX_PLATFORMS="cpu",
-        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        XLA_FLAGS="--xla_force_host_platform_device_count="
+                  + str(1 if multiprocess else n_devices),
         PYTHONPATH=_REPO,
     )
     args = []
     if inflate_host_ns:
-        env["DEEPFM_TPU_SYNTH_HOST_NS_PER_RECORD"] = str(inflate_host_ns)
-        # The pipeline's own decode-ahead thread (prefetch_batches) would
-        # hide the synthetic stall in BOTH variants, washing out the A/B.
-        # Pin it off so the Trainer staging thread is the only overlap
-        # mechanism under test.
+        env["DEEPFM_TPU_SYNTH_TRANSFER_NS_PER_RECORD"] = str(inflate_host_ns)
+        # The pipeline's own decode-ahead thread (prefetch_batches) could
+        # reorder host work around the inflated transfers; pin it off so
+        # the staging ring is the only overlap mechanism under test.
         args += ["--prefetch_batches", "0"]
+    mesh_data = world if multiprocess else n_devices
     args += [
         "--task_type", "train",
         "--data_dir", data_dir,
@@ -90,12 +134,12 @@ def run_once(data_dir: str, model_dir: str, transfer_ahead: int,
         "--dropout", "0.5,0.5,0.5", "--batch_size", "1024",
         "--num_epochs", str(epochs), "--learning_rate", "5e-4",
         "--compute_dtype", "bfloat16",
-        "--mesh_data", str(world), "--mesh_model", "1",
+        "--mesh_data", str(mesh_data), "--mesh_model", "1",
         "--log_steps", "0", "--save_checkpoints_steps", "0",
-        "--transfer_ahead", str(transfer_ahead),
+        "--staging_buffers", str(staging_buffers),
         "--seed", "0",
     ]
-    if world > 1:
+    if multiprocess:
         args += [
             "--dist_mode", "1",
             "--num_processes", str(world),
@@ -115,59 +159,132 @@ def run_once(data_dir: str, model_dir: str, transfer_ahead: int,
             raise RuntimeError(f"rank {r} failed:\n{err[-3000:]}")
         outs.append(out)
     line = [ln for ln in outs[0].splitlines() if ln.startswith("{")][-1]
-    return float(json.loads(line)["examples_per_sec"])
+    return json.loads(line)
+
+
+def scaling_efficiency_row(topology_kind: str, n_devices: int,
+                           eps_n: float, eps_1: float) -> dict:
+    """scaling_efficiency for one curve row — refused off real devices."""
+    if topology_kind != REAL:
+        return {
+            "scaling_efficiency": None,
+            "scaling_efficiency_reason": (
+                "refused: virtual XLA devices time-slice the host core(s); "
+                "the aggregate ratio measures time-slicing overhead, not "
+                "hardware scaling (needs topology_kind=real-devices)"),
+        }
+    if n_devices <= 1 or eps_1 <= 0:
+        return {"scaling_efficiency": 1.0 if n_devices == 1 else None}
+    return {"scaling_efficiency": round(eps_n / (n_devices * eps_1), 4)}
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--trials", type=int, default=3)
+    ap.add_argument("--device-counts", default="1,2",
+                    help="comma-separated device counts for the curve")
+    ap.add_argument("--trials", type=int, default=2)
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--inflate-host-ns", type=int, default=0,
-                    help="synthetic host-path stall, ns/record, applied to "
-                         "BOTH variants (overlap demonstration on 1 core)")
-    ap.add_argument("--single", action="store_true",
-                    help="1 process, no jax.distributed: same A/B through "
-                         "Trainer._stage's prefetch thread; the only mode "
-                         "that runs when the CPU backend lacks cross-"
-                         "process collectives")
+                    help="synthetic host->device transfer stall, ns/record, "
+                         "applied to BOTH variants (overlap demonstration "
+                         "on hosts whose real transfer is unmeasurable)")
+    ap.add_argument("--multiprocess", action="store_true",
+                    help="also run the real 2-process jax.distributed A/B "
+                         "(requires CPU cross-process collectives)")
+    ap.add_argument("--out", default=os.path.join(_REPO, "SCALING_r01.json"))
     args = ap.parse_args()
 
     from deepfm_tpu.data import libsvm
+    from deepfm_tpu.utils import mfu as mfu_lib
+
+    topology_kind, device_kind = _topology()
+    flops = _flops_per_example()
+    counts = sorted({int(x) for x in args.device_counts.split(",") if x})
 
     # File-mode fits once per epoch with a fresh ThroughputMeter, so each
     # epoch needs >2 dispatch groups (meter warmup) to measure anything:
-    # 4 files x 8192 records / 1024 world batch = 32 steps = 4 groups.
+    # 4 files x 8192 records / 1024 global batch = 32 steps = 4 groups.
     n_files, per_file = 4, 8192
     epochs = 1 if args.quick else 2
+    curve = []
     with tempfile.TemporaryDirectory() as root:
         data = os.path.join(root, "data")
         libsvm.generate_synthetic_ctr(
             data, num_files=n_files, examples_per_file=per_file,
             feature_size=117581, field_size=39, prefix="tr", seed=1)
 
-        world = 1 if args.single else 2
-        best = {0: 0.0, 2: 0.0}
-        for t in range(args.trials):
-            for ahead in (0, 2):  # interleaved: weather hits both equally
-                eps = run_once(data, os.path.join(root, f"m{t}_{ahead}"),
-                               ahead, epochs,
-                               inflate_host_ns=args.inflate_host_ns,
-                               world=world)
-                best[ahead] = max(best[ahead], eps)
-                print(f"trial {t} transfer_ahead={ahead}: {eps:,.0f} ex/s",
-                      file=sys.stderr)
+        eps1 = None
+        for n in counts:
+            best = {1: (0.0, 0.0), 2: (0.0, 0.0)}  # buffers -> (eps, ovl)
+            for t in range(args.trials):
+                for buffers in (1, 2):  # interleaved A/B
+                    r = run_once(
+                        data, os.path.join(root, f"m{n}_{t}_{buffers}"),
+                        buffers, epochs, n,
+                        inflate_host_ns=args.inflate_host_ns)
+                    eps = float(r["examples_per_sec"])
+                    ovl = float(r.get("staging_overlap_fraction", 0.0))
+                    if eps > best[buffers][0]:
+                        best[buffers] = (eps, ovl)
+                    print(f"devices={n} trial={t} staging_buffers="
+                          f"{buffers}: {eps:,.0f} ex/s overlap={ovl:.3f}",
+                          file=sys.stderr)
+            eps_n = best[2][0]
+            if n == 1 or eps1 is None:
+                eps1 = eps_n if n == 1 else eps1
+            mfu, basis, _ = mfu_lib.mfu_pct(flops, eps_n / max(n, 1))
+            row = {
+                "n_devices": n,
+                "topology_kind": topology_kind,
+                "examples_per_sec": round(eps_n, 1),
+                "serialized_eps": round(best[1][0], 1),
+                "overlap_speedup": round(eps_n / max(best[1][0], 1e-9), 3),
+                "overlap_fraction": round(best[2][1], 4),
+                "mfu_pct": mfu,
+                "mfu_basis": basis,
+            }
+            row.update(scaling_efficiency_row(
+                topology_kind, n, eps_n, eps1 or 0.0))
+            curve.append(row)
 
-        out = {
-            "topology": f"{world}-process"
-                        + ("" if args.single else " jax.distributed")
-                        + ", CPU backend, 1 host core",
-            "serialized_eps": round(best[0], 1),
-            "overlapped_eps": round(best[2], 1),
-            "overlap_speedup": round(best[2] / max(best[0], 1e-9), 3),
-        }
-        if args.inflate_host_ns:
-            out["inflate_host_ns_per_record"] = args.inflate_host_ns
-        print(json.dumps(out))
+        mp = None
+        if args.multiprocess:
+            mp_best = {1: 0.0, 2: 0.0}
+            for t in range(args.trials):
+                for buffers in (1, 2):
+                    r = run_once(
+                        data, os.path.join(root, f"mp_{t}_{buffers}"),
+                        buffers, epochs, 1,
+                        inflate_host_ns=args.inflate_host_ns,
+                        multiprocess=True)
+                    mp_best[buffers] = max(mp_best[buffers],
+                                           float(r["examples_per_sec"]))
+            mp = {
+                "topology": "2-process jax.distributed, CPU backend",
+                "topology_kind": topology_kind,
+                "serialized_eps": round(mp_best[1], 1),
+                "overlapped_eps": round(mp_best[2], 1),
+                "overlap_speedup": round(
+                    mp_best[2] / max(mp_best[1], 1e-9), 3),
+            }
+
+    out = {
+        "bench": "scaling_overlap",
+        "device_kind": device_kind,
+        "topology_kind": topology_kind,
+        "model_flops_per_example": flops,
+        "staging_ab": "staging_buffers 1 (serialized) vs 2 (double-buffered)"
+                      ", interleaved trials, best-of-N",
+        "curve": curve,
+    }
+    if args.inflate_host_ns:
+        out["inflate_host_ns_per_record"] = args.inflate_host_ns
+    if mp is not None:
+        out["multiprocess_ab"] = mp
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -55,6 +55,11 @@ def pytest_configure(config):
         "backend (2+ jax processes); skipped when jaxlib lacks them")
     config.addinivalue_line(
         "markers",
+        "multichip: real multi-process scaling/overlap runs (2 OS "
+        "processes in a jax.distributed rendezvous); gated on the same "
+        "cross-process-collectives probe as mp_collectives")
+    config.addinivalue_line(
+        "markers",
         "preempt: preemption/self-healing runtime tests (signal-driven "
         "checkpointing, NaN guard policies, stall watchdogs, supervisor)")
     config.addinivalue_line(
@@ -236,6 +241,9 @@ def pytest_collection_modifyitems(config, items):
     probes = (
         ("mesh_bitexact", "_MESH_BITEXACT_REASON", _probe_mesh_bitexact),
         ("mp_collectives", "_MP_COLLECTIVES_REASON", _probe_mp_collectives),
+        # multichip shares the mp_collectives probe (and its cached
+        # reason): both need real 2-process collectives on this backend.
+        ("multichip", "_MP_COLLECTIVES_REASON", _probe_mp_collectives),
         ("embedding", "_EMBEDDING_REASON", _probe_embedding_sparse),
     )
     for marker_name, cache_name, probe in probes:
