@@ -586,6 +586,157 @@ def multitask_series() -> dict:
     return out
 
 
+def cascade_series() -> dict:
+    """Retrieval→ranking cascade: end-to-end ``recommend()`` latency (user
+    tower -> candidate index -> packed ranking batch -> top-k) p50/p99 and
+    QPS, the ANN index's measured recall@k against the brute-force oracle,
+    and the train-throughput cost of sequence features — the SAME DIN graph
+    fit over the same batches WITH the history columns vs with them
+    stripped (the stripped run rides the empty-history fallback, so the
+    delta prices target attention + history transfer, not a different
+    model).
+
+    Honesty fields mirror the serving series: ``device_kind`` names the
+    chip; ``load_kind`` labels the latency loop as a SEQUENTIAL synthetic
+    driver (one recommend() per call, one in-process caller) — p50/p99 are
+    closed-loop single-stream numbers, not concurrent-traffic tails; and
+    recall@k is measured on this run's synthetic corpus, never assumed
+    (brute is measured too — it must read 1.0)."""
+    import glob as glob_mod
+    import shutil
+    import tempfile
+
+    import jax
+
+    from deepfm_tpu.config import Config
+    from deepfm_tpu.data import libsvm
+    from deepfm_tpu.data.pipeline import CtrPipeline
+    from deepfm_tpu.models.twin_tower import train_twin_tower
+    from deepfm_tpu.rec.cascade import CascadeEngine, export_cascade
+    from deepfm_tpu.rec.index import CandidateIndex
+    from deepfm_tpu.train import Trainer
+    from deepfm_tpu.utils import export as export_lib
+
+    fs, fields, hist, bs = 5000, 5, 8, 256
+    retrieve_k, rank_k, recall_k = 50, 10, 50
+    cfg = Config(
+        feature_size=fs, field_size=fields, embedding_size=8,
+        deep_layers="32,16", dropout="1.0,1.0", batch_size=bs,
+        learning_rate=1e-3, optimizer="Adam", l2_reg=1e-5,
+        compute_dtype="float32", log_steps=0, seed=0,
+        scale_lr_by_world=False, model="din", history_max_len=hist)
+    out = {
+        "device_kind": jax.devices()[0].device_kind,
+        "load_kind": "synthetic-sequential",
+        "corpus_items": fs,
+        "retrieve_k": retrieve_k,
+        "rank_k": rank_k,
+    }
+    tmp = tempfile.mkdtemp(prefix="bench_cascade_")
+    orig_tf = export_lib._export_tf_savedmodel
+    export_lib._export_tf_savedmodel = lambda *a, **k: None  # not served
+    try:
+        libsvm.generate_synthetic_ctr(
+            tmp, num_files=2, examples_per_file=4096, feature_size=fs,
+            field_size=fields, prefix="tr", seed=0, history=hist)
+        files = sorted(glob_mod.glob(os.path.join(tmp, "tr*.tfrecords")))
+        hist_b = list(CtrPipeline(
+            files, field_size=fields, batch_size=bs, num_epochs=1,
+            shuffle=True, shuffle_files=True, seed=0, drop_remainder=True,
+            prefetch_batches=0, history=True, history_max_len=hist))
+        plain_b = [{k: v for k, v in b.items()
+                    if k not in ("hist_ids", "hist_mask")} for b in hist_b]
+
+        # --- sequence-feature train cost: history columns on vs off -----
+        def train_eps(batches):
+            trainer = Trainer(cfg)
+            state = trainer.init_state()
+            state, _ = trainer.fit(state, batches[:2])  # compile warmup
+            t0 = time.perf_counter()
+            state, m = trainer.fit(state, batches)
+            return state, trainer, int(m["steps"]) * bs / (
+                time.perf_counter() - t0)
+
+        _, _, off_eps = train_eps(plain_b)
+        state, trainer, on_eps = train_eps(hist_b)
+        out["train_ex_per_s_history_on"] = round(on_eps, 1)
+        out["train_ex_per_s_history_off"] = round(off_eps, 1)
+        out["history_on_over_off_ratio"] = round(
+            on_eps / max(off_eps, 1e-9), 3)
+
+        # --- retrieval stage: towers + index, recall measured ----------
+        tower_model, tower_params, _ = train_twin_tower(cfg, hist_b)
+        items = tower_model.all_item_embeddings(tower_params, fs)
+        queries = np.asarray(tower_model.user_embed(
+            tower_params, hist_b[0]["hist_ids"], hist_b[0]["hist_mask"]))
+        brute = CandidateIndex(items, kind="brute")
+        ann = CandidateIndex(items, kind="ann", seed=0)
+        # A second measured operating point on the recall-vs-latency curve
+        # (TUNING.md §2.14): same corpus, half the partitions probed.
+        ann_wide = CandidateIndex(items, kind="ann", seed=0,
+                                  num_partitions=32, nprobe=16)
+        out["recall_at_k"] = recall_k
+        out["brute_recall"] = round(brute.recall_at_k(queries, recall_k), 4)
+
+        def ann_point(idx):
+            r = idx.recall_at_k(queries, recall_k)
+            t0 = time.perf_counter()
+            idx.search(queries, recall_k)
+            ms = 1000 * (time.perf_counter() - t0) / queries.shape[0]
+            return {"num_partitions": idx.num_partitions,
+                    "nprobe": idx.nprobe,
+                    "recall": round(r, 4),
+                    "search_ms_per_query": round(ms, 4)}
+
+        out["ann_default"] = ann_point(ann)
+        out["ann_wide_probe"] = ann_point(ann_wide)
+        out["ann_recall"] = out["ann_default"]["recall"]
+
+        # --- end-to-end recommend() latency over a live artifact -------
+        publish_dir = os.path.join(tmp, "publish")
+        export_cascade(
+            trainer.model, state, cfg, os.path.join(publish_dir, "1"),
+            tower_params=tower_params, index=ann,
+            index_meta={"recall_at_50": out["ann_recall"]})
+        export_lib.write_latest(publish_dir, "1")
+        engine = CascadeEngine(
+            publish_dir, retrieve_k=retrieve_k, max_batch=64,
+            max_delay_ms=1.0, watcher_kw={"poll_secs": 3600, "start": False})
+        try:
+            # (the watcher's constructor already did the initial check_once)
+            assert engine.watcher.swap_count >= 1, "cascade artifact not loaded"
+            rng = np.random.default_rng(7)
+
+            def one_request():
+                ln = int(rng.integers(1, hist + 1))
+                h_ids = np.zeros((hist,), np.int32)
+                h_ids[:ln] = rng.integers(1, fs, ln)
+                h_mask = (np.arange(hist) < ln).astype(np.float32)
+                ids = rng.integers(0, fs, fields).astype(np.int32)
+                vals = rng.normal(size=fields).astype(np.float32)
+                return engine.recommend(h_ids, h_mask, ids, vals, k=rank_k)
+
+            for _ in range(5):  # compile/warm both stages + buckets
+                one_request()
+            lat = []
+            t_all = time.perf_counter()
+            for _ in range(60):
+                t0 = time.perf_counter()
+                cand, probs = one_request()
+                lat.append(1000 * (time.perf_counter() - t0))
+                assert np.all(np.isfinite(probs)), probs
+            wall = time.perf_counter() - t_all
+            out["e2e_p50_ms"] = round(float(np.percentile(lat, 50)), 3)
+            out["e2e_p99_ms"] = round(float(np.percentile(lat, 99)), 3)
+            out["e2e_qps"] = round(len(lat) / wall, 1)
+        finally:
+            engine.close()
+    finally:
+        export_lib._export_tf_savedmodel = orig_tf
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def pallas_ab_device_ratio() -> dict:
     """Interleaved Pallas-vs-XLA A/B over the device-only staged multi-step
     (no transfer inside the timed window) — the regression canary for the
@@ -791,6 +942,12 @@ def main() -> None:
         print(f"bench: multitask series error: {e}", file=sys.stderr)
         multitask = {"error": str(e)}
 
+    try:
+        cascade = cascade_series()
+    except Exception as e:
+        print(f"bench: cascade series error: {e}", file=sys.stderr)
+        cascade = {"error": str(e)}
+
     nominal_per_accel_baseline = 250_000.0 / 4.0
     # MFU from the device-only series (no transfer in the window): model
     # FLOPs/example x device-only examples/sec/chip over the device peak.
@@ -830,6 +987,7 @@ def main() -> None:
         "online_publish": online_publish,
         "serving": serving,
         "multitask": multitask,
+        "cascade": cascade,
         "pallas_smoke": pallas_smoke,
     }
     if scaling is not None:

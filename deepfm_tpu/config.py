@@ -49,7 +49,7 @@ class Config:
     coordinator_address: str = ""     # jax.distributed coordinator (host:port)
 
     # ---- model hyperparameters (reference: model flags) ----
-    model: str = "deepfm"             # deepfm | widedeep | dcnv2
+    model: str = "deepfm"             # deepfm | widedeep | dcnv2 | dlrm | din | bst
     feature_size: int = 117581        # vocabulary size (reference ipynb:85)
     field_size: int = 39              # number of fields (reference ipynb:90)
     embedding_size: int = 32          # latent dim (reference flag default, ...py:44)
@@ -77,6 +77,21 @@ class Config:
     # SIGIR 2018 — requires exactly the 2-task contract).
     multitask: str = "shared_bottom"  # shared_bottom | mmoe | esmm
     mmoe_experts: int = 4             # expert count for --multitask mmoe
+
+    # ---- retrieval->ranking cascade (README "Retrieval→ranking cascade",
+    #      TUNING §2.14) ----
+    # User-history sequence length. 0 disables history; > 0 makes the
+    # pipeline decode the optional ragged hist_ids/hist_vals TFRecord pair
+    # into fixed [B, history_max_len] id/mask columns (padded/truncated)
+    # that sequence models (din/bst) attend over. Incompatible with the
+    # two-label multi-task contract and with embedding_update=sparse (the
+    # sparse plan covers feat_ids only).
+    history_max_len: int = 0
+    # Candidate-index structure for the retrieval stage (rec/index.py):
+    # "brute" = exact jit top-k over all item embeddings; "ann" = quantized
+    # partition scan (approximate; recall@k is measured against brute force
+    # and stamped into the exported index artifact).
+    index_kind: str = "brute"
 
     # ---- optimization ----
     optimizer: str = "Adam"           # Adam | Adagrad | Momentum | ftrl
@@ -280,8 +295,35 @@ class Config:
     def validate(self) -> None:
         if self.task_type not in ("train", "eval", "infer", "export"):
             raise ValueError(f"unknown task_type: {self.task_type!r}")
-        if self.model not in ("deepfm", "widedeep", "dcnv2", "dlrm"):
+        if self.model not in ("deepfm", "widedeep", "dcnv2", "dlrm", "din",
+                              "bst"):
             raise ValueError(f"unknown model: {self.model!r}")
+        if self.history_max_len < 0:
+            raise ValueError("history_max_len must be >= 0")
+        if self.index_kind not in ("brute", "ann"):
+            raise ValueError(
+                f"index_kind must be brute|ann, got {self.index_kind!r}")
+        if self.history_max_len > 0:
+            if self.num_tasks > 1:
+                raise ValueError(
+                    "history_max_len > 0 is incompatible with multi-task "
+                    "training (the stream carries ONE optional schema "
+                    "extension: label2 OR hist_ids/hist_vals)")
+            if self.embedding_update == "sparse":
+                raise ValueError(
+                    "history_max_len > 0 requires embedding_update=dense "
+                    "(the sparse row plan covers feat_ids only, so history "
+                    "gradients would be dropped)")
+            if self.device_dataset:
+                raise ValueError(
+                    "history_max_len > 0 is incompatible with "
+                    "device_dataset (history batches run the eager host "
+                    "pipeline)")
+            if self.pipe_mode == 1:
+                raise ValueError(
+                    "history_max_len > 0 requires file mode (pipe_mode=0); "
+                    "the streaming pipeline does not decode the history "
+                    "pair yet")
         names = self.task_names
         if not names:
             raise ValueError("tasks must name at least one task")

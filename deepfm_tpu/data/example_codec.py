@@ -283,14 +283,23 @@ VALS_KEY = "values"
 # still accepted on read.
 LEGACY_IDS_KEY = "feat_ids"
 LEGACY_VALS_KEY = "feat_vals"
+# Optional ragged user-history pair (variable length, may be absent or
+# empty). Decoded into fixed [max_len] id/mask columns by
+# decode_ctr_example_hist / the native dfm_decode_ctr_hist entry.
+HIST_IDS_KEY = "hist_ids"
+HIST_VALS_KEY = "hist_vals"
 
 
 def encode_ctr_example(label: float, ids: np.ndarray, vals: np.ndarray,
-                       label2: Optional[float] = None) -> bytes:
+                       label2: Optional[float] = None,
+                       hist_ids: Optional[np.ndarray] = None,
+                       hist_vals: Optional[np.ndarray] = None) -> bytes:
     """Encode the reference CTR schema (tools/libsvm_to_tfrecord.py:25-33).
 
     ``label2`` (second-task label) is appended as an extra ``label2`` float
-    key when given; with ``label2=None`` the output is byte-identical to the
+    key when given; ``hist_ids``/``hist_vals`` (ragged user history, any
+    length including zero) are appended as an extra int64/float pair when
+    given. With all optionals ``None`` the output is byte-identical to the
     historical single-label encoding, so existing files and golden bytes are
     unaffected.
     """
@@ -301,6 +310,11 @@ def encode_ctr_example(label: float, ids: np.ndarray, vals: np.ndarray,
     }
     if label2 is not None:
         features[LABEL2_KEY] = (np.asarray([label2], np.float32), "float")
+    if hist_ids is not None:
+        features[HIST_IDS_KEY] = (np.asarray(hist_ids, np.int64), "int64")
+        hv = hist_vals if hist_vals is not None else np.ones(
+            len(np.asarray(hist_ids)), np.float32)
+        features[HIST_VALS_KEY] = (np.asarray(hv, np.float32), "float")
     return encode_example(features)
 
 
@@ -355,3 +369,35 @@ def decode_ctr_example2(
                 f"'label2' must be a single float, got {l2.shape[0]} values")
         label2 = float(l2[0])
     return label, label2, ids, vals
+
+
+def decode_ctr_example_hist(
+        buf: bytes, field_size: int, max_len: int
+) -> Tuple[float, np.ndarray, np.ndarray, np.ndarray, np.ndarray, int]:
+    """History variant of :func:`decode_ctr_example` for sequence models.
+
+    Returns ``(label, ids, vals, hist_ids [max_len] int32,
+    hist_vals [max_len] float32, hist_len)``. The ragged history pair is
+    zero-padded to ``max_len`` and silently truncated past it
+    (``hist_len = min(actual, max_len)``); records with neither history key
+    decode with ``hist_len = 0`` and all-zero columns, so single-task files
+    without history remain readable. A record carrying only one of the pair,
+    or the pair with differing lengths, is a schema error. This is the
+    bit-identical Python mirror of the native ``dfm_decode_ctr_hist`` entry.
+    """
+    feats = decode_example(buf)
+    label, ids, vals = decode_ctr_example(buf, field_size)
+    h_ids = np.asarray(feats[HIST_IDS_KEY][1], np.int64) \
+        if HIST_IDS_KEY in feats else np.zeros((0,), np.int64)
+    h_vals = np.asarray(feats[HIST_VALS_KEY][1], np.float32) \
+        if HIST_VALS_KEY in feats else np.zeros((0,), np.float32)
+    if h_ids.shape[0] != h_vals.shape[0]:
+        raise ValueError(
+            f"history length mismatch: {h_ids.shape[0]} hist_ids vs "
+            f"{h_vals.shape[0]} hist_vals")
+    n = min(h_ids.shape[0], int(max_len))
+    out_ids = np.zeros((max_len,), np.int32)
+    out_vals = np.zeros((max_len,), np.float32)
+    out_ids[:n] = h_ids[:n].astype(np.int32)
+    out_vals[:n] = h_vals[:n]
+    return label, ids, vals, out_ids, out_vals, n

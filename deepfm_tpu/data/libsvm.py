@@ -99,6 +99,7 @@ def generate_synthetic_ctr(
     seed: int = 0,
     hidden_seed: int = 12345,
     num_labels: int = 1,
+    history: int = 0,
 ) -> List[str]:
     """Write synthetic Criteo-shaped TFRecords with a learnable signal.
 
@@ -116,15 +117,28 @@ def generate_synthetic_ctr(
     setup), so both tasks are learnable and realistically correlated. With
     the default ``num_labels=1`` no extra rng draws happen and the output
     is byte-identical to previous versions.
+
+    With ``history > 0`` each Example additionally carries a ragged
+    click-gated ``hist_ids``/``hist_vals`` pair: the history is sampled from
+    the ids of PREVIOUSLY CLICKED examples in the stream (a rolling pool, so
+    early records naturally have empty histories), its length is uniform in
+    ``[0, history]``, and the click logit gains an affinity term between the
+    history and the candidate through the same hidden vector — target
+    attention over the history is therefore genuinely learnable. With the
+    default ``history=0`` no extra rng draws happen and the output is
+    byte-identical.
     """
     if num_labels not in (1, 2):
         raise ValueError(f"num_labels must be 1 or 2, got {num_labels}")
+    if history < 0:
+        raise ValueError(f"history must be >= 0, got {history}")
     os.makedirs(out_dir, exist_ok=True)
     rng = np.random.default_rng(seed)
     hidden_w = np.random.default_rng(hidden_seed).normal(
         0, 1.0, size=feature_size).astype(np.float32)
     hidden_w2 = np.random.default_rng(hidden_seed + 1).normal(
         0, 1.0, size=feature_size).astype(np.float32)
+    clicked_pool: List[int] = []  # rolling pool of clicked ids (click-gated)
     paths = []
     for fi in range(num_files):
         path = os.path.join(out_dir, f"{prefix}_{fi:04d}.tfrecords")
@@ -134,15 +148,29 @@ def generate_synthetic_ctr(
                 ids = rng.integers(0, feature_size, size=field_size, dtype=np.int64)
                 vals = rng.normal(0, 1, size=field_size).astype(np.float32)
                 logit = float(np.dot(hidden_w[ids], vals)) * 0.5
+                hist_ids = None
+                if history > 0:
+                    hist_n = min(int(rng.integers(0, history + 1)),
+                                 len(clicked_pool))
+                    if hist_n > 0:
+                        pick = rng.integers(0, len(clicked_pool), size=hist_n)
+                        hist_ids = np.asarray(
+                            [clicked_pool[j] for j in pick], np.int64)
+                        # history/candidate affinity through the hidden model
+                        logit += float(np.mean(hidden_w[hist_ids])) \
+                            * float(np.mean(hidden_w[ids])) * 2.0
                 label = float(rng.random() < 1.0 / (1.0 + np.exp(-logit)))
-                if num_labels == 1:
-                    w.write(example_codec.encode_ctr_example(label, ids, vals))
-                    continue
-                label2 = 0.0
-                if label > 0:
-                    logit2 = float(np.dot(hidden_w2[ids], vals)) * 0.5
-                    label2 = float(
-                        rng.random() < 1.0 / (1.0 + np.exp(-logit2)))
+                if history > 0 and label > 0:
+                    clicked_pool.extend(int(i) for i in ids)
+                    if len(clicked_pool) > 4096:
+                        del clicked_pool[:-4096]
+                label2 = None
+                if num_labels == 2:
+                    label2 = 0.0
+                    if label > 0:
+                        logit2 = float(np.dot(hidden_w2[ids], vals)) * 0.5
+                        label2 = float(
+                            rng.random() < 1.0 / (1.0 + np.exp(-logit2)))
                 w.write(example_codec.encode_ctr_example(
-                    label, ids, vals, label2=label2))
+                    label, ids, vals, label2=label2, hist_ids=hist_ids))
     return paths

@@ -94,6 +94,46 @@ def _get_decoder2(use_native: bool):
     return decode_batch2_python
 
 
+def decode_batch_hist_python(records: Sequence[bytes], field_size: int,
+                             max_len: int
+                             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                        np.ndarray, np.ndarray, np.ndarray]:
+    """History decode fallback (sequence-model input): the ragged
+    ``hist_ids``/``hist_vals`` pair zero-padded/truncated to ``max_len`` per
+    record. Mirrors ``native.loader.decode_batch_hist``."""
+    n = len(records)
+    labels = np.empty((n,), np.float32)
+    ids = np.empty((n, field_size), np.int32)
+    vals = np.empty((n, field_size), np.float32)
+    hist_ids = np.zeros((n, max_len), np.int32)
+    hist_vals = np.zeros((n, max_len), np.float32)
+    hist_len = np.zeros((n,), np.int32)
+    for i, rec in enumerate(records):
+        lab, rid, rval, hid, hval, hn = example_codec.decode_ctr_example_hist(
+            rec, field_size, max_len)
+        labels[i] = lab
+        ids[i] = rid.astype(np.int32)
+        vals[i] = rval
+        hist_ids[i] = hid
+        hist_vals[i] = hval
+        hist_len[i] = hn
+    return labels, ids, vals, hist_ids, hist_vals, hist_len
+
+
+def _get_decoder_hist(use_native: bool):
+    """History sibling of ``_get_decoder``. The native entry internally
+    falls back per-record to the Python codec mirror on a stale .so, so
+    either return emits identical values."""
+    if use_native:
+        try:
+            from ..native import loader  # noqa: PLC0415
+            if loader.available():
+                return loader.decode_batch_hist
+        except Exception:
+            pass
+    return decode_batch_hist_python
+
+
 # Chunk size for the native streaming reader: big enough to amortize the
 # per-call framing cost, small enough to keep RSS constant on huge shards.
 _NATIVE_CHUNK_BYTES = 64 << 20
@@ -362,6 +402,8 @@ class CtrPipeline:
         decoded_cache: str = "off",
         decoded_cache_dir: str = "",
         num_labels: int = 1,
+        history: bool = False,
+        history_max_len: int = 20,
     ):
         if shard is not None:
             self._files: Tuple[str, ...] = shard.files
@@ -426,8 +468,38 @@ class CtrPipeline:
             native_assembly = False
             self.native_assembly = False
             decoded_cache = "off"
+        # History emission (sequence models): batches gain fixed "hist_ids"
+        # int32[B, L] / "hist_mask" f32[B, L] columns decoded from the
+        # optional ragged on-disk pair, padded/truncated to history_max_len
+        # (the mask is the decoded hist_vals column — zero past each
+        # record's actual length, so it doubles as attention weights). Like
+        # num_labels>1, the history stream takes the eager decode path only:
+        # the fused drain entry, the shm worker slabs, and the decoded
+        # cache are fixed-arity single-label layouts by design.
+        self.history = bool(history)
+        self.history_max_len = int(history_max_len)
+        if self.history and self.num_labels > 1:
+            raise ValueError(
+                "history=True is incompatible with num_labels>1 (one "
+                "optional schema extension per stream)")
+        if self.history and self.history_max_len < 1:
+            raise ValueError(
+                f"history_max_len must be >= 1 when history=True, got "
+                f"{history_max_len}")
+        if self.history:
+            input_workers = 0
+            native_assembly = False
+            self.native_assembly = False
+            decoded_cache = "off"
+        # Pool/chunk column width: history rides the existing (labels, ids,
+        # vals) chunk tuples as extra packed columns (ids -> [n, F+L] int32
+        # feat||hist ids, vals -> [n, F+L] f32 feat vals||hist mask), split
+        # back out at batch-assembly time.
+        self._pool_cols = self.field_size + (
+            self.history_max_len if self.history else 0)
         self._decode = _get_decoder(use_native_decoder)
         self._decode2 = _get_decoder2(use_native_decoder)
+        self._decode_hist = _get_decoder_hist(use_native_decoder)
         # Multi-process input service (opt-in, see workers.py): decode
         # worker processes feed shared-memory slabs; 0 = in-process decode
         # (the default path, byte-for-byte unchanged). Engaged only where
@@ -486,6 +558,13 @@ class CtrPipeline:
                 labels, labels2, ids, vals = loader.decode_spans2(
                     buf, offsets, lengths, self.field_size)
                 return np.stack([labels, labels2], axis=1), ids, vals
+            if self.history:
+                labels, ids, vals, hid, hmask, _ = loader.decode_spans_hist(
+                    buf, offsets, lengths, self.field_size,
+                    self.history_max_len)
+                # Packed-column chunk layout (see __init__): feat||hist.
+                return (labels, np.hstack([ids, hid]),
+                        np.hstack([vals, hmask]))
             return loader.decode_spans(buf, offsets, lengths, self.field_size)
 
         jobs = self._iter_framed_span_chunks(epoch, loader)
@@ -807,6 +886,7 @@ class CtrPipeline:
         # (only) the kept rows and frees each buffer immediately.
         fused = (not use_shm and self.shuffle and loader is not None
                  and self._record_shard is None and self.num_labels == 1
+                 and not self.history
                  and hasattr(loader, "decode_spans_scatter"))
         # Drain-decode executor: per-ITERATOR, not per-pipeline — two live
         # iterators of one pipeline must not share (advisor r5: the first
@@ -847,9 +927,9 @@ class CtrPipeline:
                             labels = np.empty((n_pend, self.num_labels),
                                               np.float32)
                             lab_col = labels.reshape(-1)
-                            ids = np.empty((n_pend, self.field_size),
+                            ids = np.empty((n_pend, self._pool_cols),
                                            np.int32)
-                            vals = np.empty((n_pend, self.field_size),
+                            vals = np.empty((n_pend, self._pool_cols),
                                             np.float32)
                             off = 0
                             for lab, idx, val in pend:
@@ -872,9 +952,10 @@ class CtrPipeline:
                             # the fresh pool arrays above — hand the slots
                             # back so workers refill them while we slice.
                             service.release_consumed()
+                    hl = self.history_max_len if self.history else 0
                     while n_pend >= sb:
                         with _timed(stats, "emit"):
-                            rows = self._assemble_batch(pend, sb)
+                            rows = self._assemble_batch(pend, sb, hl)
                         if stall_ns:
                             time.sleep(stall_ns * sb * 1e-9)
                         yield rows, k, sb
@@ -882,14 +963,14 @@ class CtrPipeline:
                     if final:
                         while n_pend >= bs:
                             with _timed(stats, "emit"):
-                                rows = self._assemble_batch(pend, bs)
+                                rows = self._assemble_batch(pend, bs, hl)
                             if stall_ns:
                                 time.sleep(stall_ns * bs * 1e-9)
                             yield rows, 1, bs
                             n_pend -= bs
                         if n_pend and not self.drop_remainder:
                             with _timed(stats, "emit"):
-                                rows = self._assemble_batch(pend, n_pend)
+                                rows = self._assemble_batch(pend, n_pend, hl)
                             if stall_ns:
                                 time.sleep(stall_ns * n_pend * 1e-9)
                             yield rows, 1, n_pend
@@ -964,10 +1045,12 @@ class CtrPipeline:
 
     @staticmethod
     def _assemble_batch(pend: "collections.deque",
-                        bs: int) -> Batch:
+                        bs: int, hist_len: int = 0) -> Batch:
         """Pop exactly ``bs`` rows off the front of the pending chunk
         deque (O(1) per chunk; a list's pop(0) re-shifts the whole pool
-        every batch)."""
+        every batch). With ``hist_len > 0`` the chunks carry packed
+        feat||hist columns (see ``__init__``); the trailing ``hist_len``
+        columns split out into the ``hist_ids``/``hist_mask`` batch keys."""
         take: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
         need = bs
         while need:
@@ -985,6 +1068,16 @@ class CtrPipeline:
             labels = np.concatenate([t[0] for t in take])
             ids = np.concatenate([t[1] for t in take])
             vals = np.concatenate([t[2] for t in take])
+        if hist_len:
+            fs = ids.shape[1] - hist_len
+            return {
+                "feat_ids": np.ascontiguousarray(ids[:, :fs], np.int32),
+                "feat_vals": np.ascontiguousarray(vals[:, :fs], np.float32),
+                "hist_ids": np.ascontiguousarray(ids[:, fs:], np.int32),
+                "hist_mask": np.ascontiguousarray(vals[:, fs:], np.float32),
+                "label": np.ascontiguousarray(
+                    labels.reshape(-1, 1), np.float32),
+            }
         # ascontiguousarray, not astype: a contiguous float32 pool slice
         # (the shuffled drain's [n, 1] label column, and all ids/vals)
         # passes through as a zero-copy view — same bytes, no per-emission
@@ -1069,6 +1162,16 @@ class CtrPipeline:
                 "feat_vals": np.ascontiguousarray(vals, np.float32),
                 "label": labels.reshape(-1, 1).astype(np.float32),
                 "label2": labels2.reshape(-1, 1).astype(np.float32),
+            }
+        if self.history:
+            labels, ids, vals, hid, hmask, _ = self._decode_hist(
+                records, self.field_size, self.history_max_len)
+            return {
+                "feat_ids": np.ascontiguousarray(ids, np.int32),
+                "feat_vals": np.ascontiguousarray(vals, np.float32),
+                "hist_ids": np.ascontiguousarray(hid, np.int32),
+                "hist_mask": np.ascontiguousarray(hmask, np.float32),
+                "label": labels.reshape(-1, 1).astype(np.float32),
             }
         labels, ids, vals = self._decode(records, self.field_size)
         return {

@@ -13,6 +13,7 @@ from .dcnv2 import DCNv2  # noqa: F401
 from .deepfm import DeepFM  # noqa: F401
 from .graph import DLRM  # noqa: F401
 from .multitask import MultiTaskModel  # noqa: F401
+from .sequence import GraphBST, GraphDIN  # noqa: F401
 from .widedeep import WideDeep  # noqa: F401
 
 _REGISTRY = {
@@ -20,9 +21,12 @@ _REGISTRY = {
     "widedeep": WideDeep,
     "dcnv2": DCNv2,
     "dlrm": DLRM,
+    "din": GraphDIN,
+    "bst": GraphBST,
 }
 
-CtrModel = Union[DeepFM, WideDeep, DCNv2, DLRM, MultiTaskModel]
+CtrModel = Union[DeepFM, WideDeep, DCNv2, DLRM, GraphDIN, GraphBST,
+                 MultiTaskModel]
 
 
 def registered_models():

@@ -1,0 +1,231 @@
+"""Sequence models over user-history features: DIN/BST target attention.
+
+Opens the variable-length scenario class on top of the PR-9 graph refactor:
+batches may carry ``hist_ids`` int32 [B, L] / ``hist_mask`` f32 [B, L]
+columns (the pipeline's fixed-shape padding of the ragged on-disk
+``hist_ids``/``hist_vals`` pair), and the graphs here attend over that
+history with the CANDIDATE as the query:
+
+  * ``GraphDIN`` — Deep Interest Network (Zhou et al., KDD'18) target
+    attention: additive-MLP relevance scores between the candidate
+    embedding and each history embedding, mask-aware softmax
+    (``ops.fm.masked_softmax`` — exact zeros, never NaN, on empty
+    histories), attention-weighted history sum appended to the DeepFM
+    tower input.
+  * ``GraphBST`` — Behavior Sequence Transformer (Chen et al., 2019):
+    ONE transformer block with learned positions over
+    ``[history..., target]``, the target slot's output appended to the
+    tower input.
+
+Both keep the full DeepFM interaction path (fm_w/fm_v first+second order),
+so they are drop-in members of the zoo: same ``apply`` contract, same
+``embedding_param_names`` — history lookups route through the SAME
+``EmbeddingSchema`` entry ``fm_v`` (hash bucketing and row sharding compose
+for free). Called without history kwargs they see an empty history (the
+attention contributes exact zeros), which is what the parametrized
+zoo/checkpoint/forward tests exercise.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import fm as fm_ops
+from . import common
+from .graph import GraphDeepFM, fm_block
+
+
+def init_target_attention(key: jax.Array, k_dim: int, att_dim: int
+                          ) -> Dict[str, jnp.ndarray]:
+    """DIN attention unit params: additive MLP over
+    [query, key, query-key, query*key] -> score."""
+    return {
+        "w1": common.glorot_uniform(key, (4 * k_dim, att_dim)),
+        "b1": jnp.zeros((att_dim,), jnp.float32),
+        "w2": common.glorot_uniform(jax.random.fold_in(key, 1), (att_dim, 1)),
+        "b2": jnp.zeros((1,), jnp.float32),
+    }
+
+
+def target_attention(att: Dict[str, jnp.ndarray], query: jnp.ndarray,
+                     keys: jnp.ndarray, mask: jnp.ndarray,
+                     compute_dtype: jnp.dtype = jnp.float32) -> jnp.ndarray:
+    """DIN-style target attention block.
+
+    query [B, K] (candidate embedding), keys [B, L, K] (history
+    embeddings), mask [B, L] (>0 = real history position). Returns the
+    attention-weighted history sum [B, K]; an all-masked (empty) history
+    row returns exact zeros via ``masked_softmax``.
+    """
+    cdt = compute_dtype
+    q = jnp.broadcast_to(query[:, None, :], keys.shape).astype(cdt)
+    k = keys.astype(cdt)
+    feats = jnp.concatenate([q, k, q - k, q * k], axis=-1)  # [B, L, 4K]
+    h = jax.nn.relu(feats @ att["w1"].astype(cdt) + att["b1"].astype(cdt))
+    scores = (h @ att["w2"].astype(cdt) + att["b2"].astype(cdt))[..., 0]
+    weights = fm_ops.masked_softmax(scores.astype(jnp.float32),
+                                    mask.astype(jnp.float32))  # [B, L]
+    return jnp.sum(weights[..., None] * keys.astype(jnp.float32), axis=1)
+
+
+def _empty_history(batch: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Static-shape stand-in when a caller passes no history: one all-masked
+    position, so the attention output is exactly zero."""
+    return (jnp.zeros((batch, 1), jnp.int32),
+            jnp.zeros((batch, 1), jnp.float32))
+
+
+class GraphDIN(GraphDeepFM):
+    """DeepFM + DIN target attention over the user history.
+
+    Tower input grows by one K-vector (the attended history); everything
+    else — embedding entries, fm_block, head — is the DeepFM graph, so
+    ``fm_v`` keeps a nonzero gradient even with an empty history.
+    """
+
+    name = "din"
+    #: trainer forwards hist_ids/hist_mask batch columns when present
+    uses_history = True
+
+    def _att_dim(self) -> int:
+        return max(8, 2 * self.cfg.embedding_size)
+
+    def init(self, rng: jax.Array) -> Tuple[common.Params, common.State]:
+        cfg = self.cfg
+        k_w, k_v, k_mlp = jax.random.split(rng, 3)
+        fm_w = self.emb.init_entry(k_w, ())
+        fm_v = self.emb.init_entry(k_v, (cfg.embedding_size,))
+        tower, bn_state = common.init_tower(
+            k_mlp, cfg.field_size * cfg.embedding_size + cfg.embedding_size,
+            cfg.deep_layer_sizes, cfg.batch_norm)
+        params = {"fm_b": jnp.zeros((1,), jnp.float32),
+                  "fm_w": fm_w, "fm_v": fm_v, "tower": tower,
+                  "att": init_target_attention(
+                      jax.random.fold_in(rng, 13), cfg.embedding_size,
+                      self._att_dim())}
+        return params, bn_state
+
+    def _history_summary(self, params: common.Params, query: jnp.ndarray,
+                         hist_ids: jnp.ndarray, hist_mask: jnp.ndarray,
+                         shard_axis: Optional[str]) -> jnp.ndarray:
+        """[B, K] attended history. Dense schema lookup always — the sparse
+        plan covers feat_ids only (Config.validate gates sparse+history)."""
+        keys = self.emb.lookup(params["fm_v"], hist_ids,
+                               axis_name=shard_axis)  # [B, L, K]
+        return target_attention(
+            params["att"], query, keys, hist_mask,
+            compute_dtype=jnp.dtype(self.cfg.compute_dtype))
+
+    def apply(
+        self,
+        params: common.Params,
+        state: common.State,
+        feat_ids: jnp.ndarray,   # int32 [B, F]
+        feat_vals: jnp.ndarray,  # f32 [B, F]
+        *,
+        train: bool,
+        rng: Optional[jax.Array] = None,
+        shard_axis: Optional[str] = None,
+        data_axis: Optional[str] = None,
+        emb_rows: Optional[Dict[str, Any]] = None,
+        emb_plan: Optional[Dict[str, Any]] = None,
+        hist_ids: Optional[jnp.ndarray] = None,   # int32 [B, L]
+        hist_mask: Optional[jnp.ndarray] = None,  # f32 [B, L]
+    ) -> Tuple[jnp.ndarray, common.State]:
+        cfg = self.cfg
+        feat_vals = feat_vals.astype(jnp.float32)
+        if hist_ids is None:
+            hist_ids, hist_mask = _empty_history(feat_ids.shape[0])
+
+        w = self._emb_lookup(params, "fm_w", feat_ids, shard_axis,
+                             emb_rows, emb_plan)  # [B,F]
+        v = self._emb_lookup(params, "fm_v", feat_ids, shard_axis,
+                             emb_rows, emb_plan)  # [B,F,K]
+        xv = v * feat_vals[..., None]
+
+        # Candidate query: the value-weighted sum of the example's field
+        # embeddings — the "target item" representation the attention
+        # scores every history position against.
+        query = jnp.sum(xv, axis=1)  # [B, K]
+        hist = self._history_summary(params, query, hist_ids,
+                                     hist_mask, shard_axis)  # [B, K]
+
+        y_wv = fm_block(cfg, w, feat_vals, xv)
+        deep_in = jnp.concatenate(
+            [xv.reshape(xv.shape[0], cfg.field_size * cfg.embedding_size),
+             hist], axis=1)
+        tower_fn = lambda p, x: common.apply_tower(
+            p, state, x, train=train, dropout_keep=cfg.dropout_rates,
+            use_bn=cfg.batch_norm, bn_decay=cfg.batch_norm_decay, rng=rng,
+            compute_dtype=jnp.dtype(cfg.compute_dtype), data_axis=data_axis)
+        if cfg.remat:
+            y_d, new_state = jax.checkpoint(tower_fn)(params["tower"], deep_in)
+        else:
+            y_d, new_state = tower_fn(params["tower"], deep_in)
+
+        logits = params["fm_b"][0] + y_wv + y_d
+        return logits, new_state
+
+
+class GraphBST(GraphDIN):
+    """DeepFM + one transformer block over [history..., target].
+
+    Behavior Sequence Transformer (Chen et al., 2019), minimal form: the
+    history embeddings plus LEARNED position embeddings and the candidate
+    (with its own learned position) form a [B, L+1, K] sequence; one
+    single-head self-attention block (masked softmax over real positions +
+    residual) runs over it, and the target slot's output is the history
+    summary fed to the tower. Position table rows are sized by
+    ``cfg.history_max_len`` (min 1), so serving and training must agree on
+    the history length — MIGRATION documents the flag.
+    """
+
+    name = "bst"
+
+    def _pos_rows(self) -> int:
+        return max(1, int(getattr(self.cfg, "history_max_len", 0) or 0))
+
+    def init(self, rng: jax.Array) -> Tuple[common.Params, common.State]:
+        params, bn_state = super().init(rng)
+        cfg = self.cfg
+        k = jax.random.fold_in(rng, 17)
+        kk = jax.random.split(k, 5)
+        kdim = cfg.embedding_size
+        params["att"] = {
+            "pos": 0.01 * jax.random.normal(kk[0], (self._pos_rows(), kdim)),
+            "target_pos": 0.01 * jax.random.normal(kk[1], (kdim,)),
+            "wq": common.glorot_uniform(kk[2], (kdim, kdim)),
+            "wk": common.glorot_uniform(kk[3], (kdim, kdim)),
+            "wv": common.glorot_uniform(kk[4], (kdim, kdim)),
+        }
+        return params, bn_state
+
+    def _history_summary(self, params: common.Params, query: jnp.ndarray,
+                         hist_ids: jnp.ndarray, hist_mask: jnp.ndarray,
+                         shard_axis: Optional[str]) -> jnp.ndarray:
+        att = params["att"]
+        ln = hist_ids.shape[1]
+        if ln > att["pos"].shape[0]:
+            raise ValueError(
+                f"history length {ln} exceeds the learned position table "
+                f"({att['pos'].shape[0]} rows) — train and serve with the "
+                "same --history_max_len")
+        keys = self.emb.lookup(params["fm_v"], hist_ids,
+                               axis_name=shard_axis)  # [B, L, K]
+        seq = jnp.concatenate(
+            [keys + att["pos"][None, :ln, :],
+             (query + att["target_pos"])[:, None, :]], axis=1)  # [B, L+1, K]
+        mask = jnp.concatenate(
+            [(hist_mask > 0).astype(jnp.float32),
+             jnp.ones((hist_ids.shape[0], 1), jnp.float32)], axis=1)
+        q = seq @ att["wq"]
+        k = seq @ att["wk"]
+        v = seq @ att["wv"]
+        scores = jnp.einsum("blk,bmk->blm", q, k) / jnp.sqrt(
+            jnp.asarray(seq.shape[-1], jnp.float32))
+        weights = fm_ops.masked_softmax(scores, mask[:, None, :])
+        out = jnp.einsum("blm,bmk->blk", weights, v) + seq  # residual
+        return out[:, -1, :]  # the target slot's contextualized output

@@ -1,0 +1,185 @@
+"""Twin-tower (dual-encoder) retrieval model: in-batch-softmax training.
+
+The retrieval stage of the cascade (README "Retrieval→ranking cascade").
+NOT a ``--model`` zoo member — the zoo ranks one candidate per example;
+this model embeds USERS (their click history) and ITEMS (candidate ids)
+into one space so a :class:`~deepfm_tpu.rec.index.CandidateIndex` over all
+item vectors can answer "top-N items for this user" without scoring the
+whole corpus through the ranker.
+
+Training follows the sampled-softmax dual-encoder recipe (Covington et
+al., RecSys'16; Yi et al., RecSys'19): each batch's (user, clicked-item)
+pairs score against each other, every OTHER row's item serving as an
+in-batch negative — logits ``U @ I.T / temperature``, labels the diagonal.
+Rows without a click or without history carry zero weight (an empty
+history embeds every user identically — nothing to learn there).
+
+Item ids share the :class:`~deepfm_tpu.models.common.EmbeddingSchema`
+id space with the ranker (same hash bucketing), so an item id means the
+same row in both stages of the cascade.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from . import common
+
+Params = Dict[str, object]
+
+
+def _mlp_init(key: jax.Array, dims: List[int]) -> List[Dict[str, jnp.ndarray]]:
+    layers = []
+    for i, (a, b) in enumerate(zip(dims[:-1], dims[1:])):
+        layers.append({
+            "w": common.glorot_uniform(jax.random.fold_in(key, i), (a, b)),
+            "b": jnp.zeros((b,), jnp.float32),
+        })
+    return layers
+
+
+def _mlp_apply(layers, x: jnp.ndarray) -> jnp.ndarray:
+    for i, layer in enumerate(layers):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(layers) - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def _l2_normalize(x: jnp.ndarray, eps: float = 1e-12) -> jnp.ndarray:
+    # sqrt(sum + eps), NOT max(norm, eps): norm's gradient at x == 0 is
+    # NaN (0/0), and even a zero-weighted row's NaN poisons the whole
+    # in-batch logit matrix. The smoothed form is finite everywhere.
+    return x * jax.lax.rsqrt(jnp.sum(x * x, axis=-1, keepdims=True) + eps)
+
+
+class TwinTower:
+    """User tower over the history, item tower over candidate ids.
+
+    Both towers project into a shared ``embedding_size``-dim unit sphere;
+    retrieval scores are dot products (= cosine), so the candidate index
+    needs nothing but the item matrix.
+    """
+
+    #: in-batch softmax temperature (fixed; unit-norm embeddings)
+    TEMPERATURE = 0.1
+
+    def __init__(self, cfg: Config):
+        self.cfg = cfg
+        self.emb = common.EmbeddingSchema(cfg)
+        self.dim = cfg.embedding_size
+        self.padded_vocab = self.emb.padded_vocab
+
+    def init(self, rng: jax.Array) -> Params:
+        k_e, k_u, k_i = jax.random.split(rng, 3)
+        k = self.dim
+        return {
+            "emb": self.emb.init_entry(k_e, (k,)),
+            "user": _mlp_init(k_u, [k, 2 * k, k]),
+            "item": _mlp_init(k_i, [k, 2 * k, k]),
+        }
+
+    # ------------------------------------------------------------ encoders
+    def user_embed(self, params: Params, hist_ids: jnp.ndarray,
+                   hist_mask: jnp.ndarray) -> jnp.ndarray:
+        """[B, L] history -> [B, D] unit vectors. Mask-weighted mean pool;
+        an empty history pools to zeros and normalizes to zeros/eps —
+        finite, and weighted out of the loss."""
+        emb = self.emb.lookup(params["emb"], hist_ids)  # [B, L, K]
+        m = (hist_mask > 0).astype(jnp.float32)[..., None]
+        pooled = jnp.sum(emb * m, axis=1) / jnp.maximum(
+            jnp.sum(m, axis=1), 1.0)
+        return _l2_normalize(_mlp_apply(params["user"], pooled))
+
+    def item_embed(self, params: Params, item_ids: jnp.ndarray) -> jnp.ndarray:
+        """[B] item ids -> [B, D] unit vectors."""
+        emb = self.emb.lookup(params["emb"], item_ids)  # [B, K]
+        return _l2_normalize(_mlp_apply(params["item"], emb))
+
+    def all_item_embeddings(self, params: Params,
+                            num_items: int,
+                            batch: int = 4096) -> np.ndarray:
+        """[num_items, D] matrix for the candidate index, computed in
+        batches so a big vocab never materializes one giant activation."""
+        fn = jax.jit(lambda p, ids: self.item_embed(p, ids))
+        out = np.empty((num_items, self.dim), np.float32)
+        for lo in range(0, num_items, batch):
+            hi = min(lo + batch, num_items)
+            out[lo:hi] = np.asarray(
+                fn(params, jnp.arange(lo, hi, dtype=jnp.int32)))
+        return out
+
+    # ---------------------------------------------------------------- loss
+    def loss(self, params: Params, hist_ids: jnp.ndarray,
+             hist_mask: jnp.ndarray, item_ids: jnp.ndarray,
+             weights: jnp.ndarray) -> jnp.ndarray:
+        """Weighted in-batch softmax: row b's positive is item b, the other
+        B-1 items are its negatives. ``weights`` zeroes non-click /
+        empty-history rows (their columns still serve as negatives)."""
+        u = self.user_embed(params, hist_ids, hist_mask)    # [B, D]
+        v = self.item_embed(params, item_ids)               # [B, D]
+        logits = (u @ v.T) / self.TEMPERATURE               # [B, B]
+        logp = jax.nn.log_softmax(logits, axis=1)
+        nll = -jnp.diagonal(logp)                           # [B]
+        denom = jnp.maximum(jnp.sum(weights), 1.0)
+        return jnp.sum(nll * weights) / denom
+
+
+def train_twin_tower(
+    cfg: Config,
+    batches: Iterable[Dict[str, np.ndarray]],
+    *,
+    item_slot: int = 0,
+    learning_rate: Optional[float] = None,
+    seed: Optional[int] = None,
+) -> Tuple[TwinTower, Params, Dict[str, float]]:
+    """Fit a :class:`TwinTower` over history batches; returns
+    ``(model, params, stats)``.
+
+    ``batches`` is any iterable of pipeline batches carrying ``hist_ids`` /
+    ``hist_mask`` (``CtrPipeline(history=True)`` output). The positive item
+    of each example is its id in field ``item_slot`` — the cascade's
+    convention for "which field is the candidate item". Rows with no click
+    or no history get zero loss weight.
+    """
+    import optax  # noqa: PLC0415 (jax-heavy, keep module import light)
+
+    model = TwinTower(cfg)
+    params = model.init(jax.random.PRNGKey(
+        cfg.seed if seed is None else seed))
+    tx = optax.adam(cfg.learning_rate if learning_rate is None
+                    else learning_rate)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, hist_ids, hist_mask, item_ids, weights):
+        loss, grads = jax.value_and_grad(model.loss)(
+            params, hist_ids, hist_mask, item_ids, weights)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    steps, last_loss, used_rows = 0, float("nan"), 0
+    for batch in batches:
+        if "hist_ids" not in batch:
+            raise ValueError(
+                "train_twin_tower needs history batches "
+                "(CtrPipeline(history=True)); got keys "
+                f"{sorted(batch)}")
+        hist_ids = jnp.asarray(batch["hist_ids"])
+        hist_mask = jnp.asarray(batch["hist_mask"])
+        item_ids = jnp.asarray(batch["feat_ids"][:, item_slot])
+        w = (batch["label"].reshape(-1) > 0) \
+            & (np.asarray(batch["hist_mask"]).sum(axis=1) > 0)
+        weights = jnp.asarray(w.astype(np.float32))
+        params, opt_state, loss = step(
+            params, opt_state, hist_ids, hist_mask, item_ids, weights)
+        steps += 1
+        used_rows += int(w.sum())
+        last_loss = float(loss)
+    return model, params, {"steps": float(steps), "loss": last_loss,
+                           "positive_rows": float(used_rows)}

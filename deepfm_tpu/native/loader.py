@@ -122,6 +122,21 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_long)]
         except AttributeError:
             pass
+        # History decode entry (sequence models): same stale-.so probe
+        # discipline; callers key off has_hist() and fall back to the
+        # Python codec mirror.
+        try:
+            lib.dfm_decode_ctr_hist.restype = ctypes.c_long
+            lib.dfm_decode_ctr_hist.argtypes = [
+                ctypes.POINTER(ctypes.c_ubyte), ctypes.POINTER(ctypes.c_long),
+                ctypes.POINTER(ctypes.c_long), ctypes.c_long, ctypes.c_long,
+                ctypes.c_long,
+                ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_long)]
+        except AttributeError:
+            pass
         lib.dfm_crc32c.restype = ctypes.c_uint32
         lib.dfm_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_long]
         _lib = lib
@@ -228,6 +243,9 @@ def _decode_reason(code: int, field_size: int) -> str:
         -23: ("required keys missing — need 'label' plus 'ids'/'values' "
               "(reference schema) or 'feat_ids'/'feat_vals' (legacy)"),
         -24: "'label2' is not a single float",
+        -25: "malformed 'hist_ids' int64 list",
+        -26: "malformed 'hist_vals' float list",
+        -27: "'hist_ids'/'hist_vals' lengths differ (or one key missing)",
     }
     return reasons.get(code, f"malformed Example wire data (code {code})")
 
@@ -298,6 +316,81 @@ def decode_batch2(records: Sequence[bytes], field_size: int
     if len(records) > 1:
         np.cumsum(lengths[:-1], out=offsets[1:])
     return decode_spans2(buf, offsets, lengths, field_size)
+
+
+def has_hist() -> bool:
+    """True when the built library exports the history decode entry
+    (``dfm_decode_ctr_hist``). False on a stale cached .so — callers fall
+    back to the Python codec mirror, which emits identical values."""
+    lib = _load()
+    return lib is not None and hasattr(lib, "dfm_decode_ctr_hist")
+
+
+def decode_spans_hist(
+        buf, offsets: np.ndarray, lengths: np.ndarray, field_size: int,
+        max_len: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray,
+           np.ndarray]:
+    """History variant of :func:`decode_spans` for sequence models:
+    returns ``(labels, ids, vals, hist_ids [n, max_len] int32,
+    hist_vals [n, max_len] float32, hist_len [n] int32)`` with the ragged
+    ``hist_ids``/``hist_vals`` pair zero-padded and truncated to ``max_len``
+    per record (absent pair -> empty history). Falls back to the
+    bit-identical Python codec mirror when the cached library predates the
+    entry (same discipline as ``decode_spans2``)."""
+    lib = _load()
+    n = len(offsets)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    lengths = np.ascontiguousarray(lengths, dtype=np.int64)
+    labels = np.empty(n, dtype=np.float32)
+    ids = np.empty((n, field_size), dtype=np.int32)
+    vals = np.empty((n, field_size), dtype=np.float32)
+    hist_ids = np.zeros((n, max_len), dtype=np.int32)
+    hist_vals = np.zeros((n, max_len), dtype=np.float32)
+    hist_len = np.zeros(n, dtype=np.int32)
+    if lib is None or not hasattr(lib, "dfm_decode_ctr_hist"):
+        from ..data import example_codec  # noqa: PLC0415 (avoid module cycle)
+        for i, (off, ln) in enumerate(zip(offsets.tolist(), lengths.tolist())):
+            lab, rid, rval, hid, hval, hn = example_codec.decode_ctr_example_hist(
+                bytes(buf[off:off + ln]), field_size, max_len)
+            labels[i] = lab
+            ids[i] = rid.astype(np.int32)
+            vals[i] = rval
+            hist_ids[i] = hid
+            hist_vals[i] = hval
+            hist_len[i] = hn
+        return labels, ids, vals, hist_ids, hist_vals, hist_len
+    detail = ctypes.c_long(0)
+    rc = lib.dfm_decode_ctr_hist(
+        _as_ubyte_ptr(buf),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        n, field_size, max_len,
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        hist_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        hist_vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        hist_len.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.byref(detail))
+    if rc != 0:
+        raise ValueError(f"native history decode failed at record "
+                         f"{-rc - 100}: "
+                         f"{_decode_reason(detail.value, field_size)}")
+    return labels, ids, vals, hist_ids, hist_vals, hist_len
+
+
+def decode_batch_hist(records: Sequence[bytes], field_size: int, max_len: int
+                      ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                 np.ndarray, np.ndarray, np.ndarray]:
+    """History sibling of :func:`decode_batch`."""
+    buf = b"".join(records)
+    lengths = np.fromiter((len(r) for r in records), dtype=np.int64,
+                          count=len(records))
+    offsets = np.zeros(len(records), dtype=np.int64)
+    if len(records) > 1:
+        np.cumsum(lengths[:-1], out=offsets[1:])
+    return decode_spans_hist(buf, offsets, lengths, field_size, max_len)
 
 
 def decode_spans_scatter(buf, offsets: np.ndarray, lengths: np.ndarray,

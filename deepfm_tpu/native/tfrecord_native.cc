@@ -161,6 +161,68 @@ long parse_int64_list(const uint8_t* p, const uint8_t* end, int32_t* out, long c
   return n;
 }
 
+// Truncating variants for ragged history lists: write at most cap entries
+// but return the ACTUAL element count (which may exceed cap — the caller
+// clamps). Only malformed wire is an error (-1); overflow is silent
+// truncation, matching the fixed [max_len] history contract.
+long parse_float_list_trunc(const uint8_t* p, const uint8_t* end, float* out,
+                            long cap) {
+  long n = 0;
+  while (p < end) {
+    uint64_t tag;
+    if (!read_varint(p, end, &tag)) return -1;
+    uint32_t field = tag >> 3, wire = tag & 7;
+    if (field == 1 && wire == 2) {  // packed
+      uint64_t len;
+      if (!read_varint(p, end, &len) || static_cast<uint64_t>(end - p) < len)
+        return -1;
+      long cnt = len / 4;
+      long keep = (n < cap) ? ((cnt < cap - n) ? cnt : cap - n) : 0;
+      if (keep > 0) std::memcpy(out + n, p, keep * 4);
+      n += cnt;
+      p += len;
+    } else if (field == 1 && wire == 5) {  // unpacked
+      if (end - p < 4) return -1;
+      if (n < cap) std::memcpy(out + n, p, 4);
+      ++n;
+      p += 4;
+    } else {
+      if (!skip_field(p, end, wire)) return -1;
+    }
+  }
+  return n;
+}
+
+long parse_int64_list_trunc(const uint8_t* p, const uint8_t* end, int32_t* out,
+                            long cap) {
+  long n = 0;
+  while (p < end) {
+    uint64_t tag;
+    if (!read_varint(p, end, &tag)) return -1;
+    uint32_t field = tag >> 3, wire = tag & 7;
+    if (field == 1 && wire == 2) {  // packed
+      uint64_t len;
+      if (!read_varint(p, end, &len) || static_cast<uint64_t>(end - p) < len)
+        return -1;
+      const uint8_t* stop = p + len;
+      while (p < stop) {
+        uint64_t v;
+        if (!read_varint(p, stop, &v)) return -1;
+        if (n < cap) out[n] = static_cast<int32_t>(static_cast<int64_t>(v));
+        ++n;
+      }
+    } else if (field == 1 && wire == 0) {
+      uint64_t v;
+      if (!read_varint(p, end, &v)) return -1;
+      if (n < cap) out[n] = static_cast<int32_t>(static_cast<int64_t>(v));
+      ++n;
+    } else {
+      if (!skip_field(p, end, wire)) return -1;
+    }
+  }
+  return n;
+}
+
 struct KeyRef { const uint8_t* p; uint64_t len; };
 
 inline bool key_is(const KeyRef& k, const char* s) {
@@ -171,12 +233,23 @@ inline bool key_is(const KeyRef& k, const char* s) {
 // Parse one serialized Example. Returns 0 ok, negative error. label2 (when
 // non-null) receives the optional "label2" float key, defaulting to 0.0f
 // when the key is absent — single-label files stay decodable as multi-task
-// input; existing callers pass nullptr and are untouched.
+// input; existing callers pass nullptr and are untouched. hist_ids/hist_vals
+// (when non-null, sized [max_hist]) receive the optional ragged
+// "hist_ids"/"hist_vals" pair zero-padded and silently truncated to
+// max_hist, with *hist_len = min(actual, max_hist); both keys absent decodes
+// as an empty history. One key without the other, or differing lengths, is a
+// schema error (-27).
 long parse_ctr_example(const uint8_t* p, const uint8_t* end, long field_size,
                        float* label, int32_t* ids, float* vals,
-                       float* label2 = nullptr) {
+                       float* label2 = nullptr, long max_hist = 0,
+                       int32_t* hist_ids = nullptr, float* hist_vals = nullptr,
+                       int32_t* hist_len = nullptr) {
   bool got_label = false, got_ids = false, got_vals = false;
   if (label2) *label2 = 0.0f;
+  long hist_ids_n = 0, hist_vals_n = 0;
+  if (hist_ids) std::memset(hist_ids, 0, max_hist * sizeof(int32_t));
+  if (hist_vals) std::memset(hist_vals, 0, max_hist * sizeof(float));
+  if (hist_len) *hist_len = 0;
   while (p < end) {
     uint64_t tag;
     if (!read_varint(p, end, &tag)) return -10;
@@ -250,7 +323,20 @@ long parse_ctr_example(const uint8_t* p, const uint8_t* end, long field_size,
         if (parse_float_list(payload, pend, vals, field_size) != field_size)
           return -22;
         got_vals = true;
+      } else if (hist_ids && key_is(key, "hist_ids") && vfield == 3) {
+        hist_ids_n = parse_int64_list_trunc(payload, pend, hist_ids, max_hist);
+        if (hist_ids_n < 0) return -25;
+      } else if (hist_vals && key_is(key, "hist_vals") && vfield == 2) {
+        hist_vals_n = parse_float_list_trunc(payload, pend, hist_vals, max_hist);
+        if (hist_vals_n < 0) return -26;
       }
+    }
+  }
+  if (hist_ids) {
+    if (hist_ids_n != hist_vals_n) return -27;
+    if (hist_len) {
+      *hist_len = static_cast<int32_t>(
+          hist_ids_n < max_hist ? hist_ids_n : max_hist);
     }
   }
   return (got_label && got_ids && got_vals) ? 0 : -23;
@@ -359,6 +445,32 @@ long dfm_decode_ctr2_ex(const uint8_t* buf, const long* offsets,
     long rc = parse_ctr_example(p, p + lengths[i], field_size, labels + i,
                                 ids + i * field_size, vals + i * field_size,
                                 labels2 + i);
+    if (rc != 0) {
+      if (err_detail) *err_detail = rc;
+      return -(100 + i);
+    }
+  }
+  return 0;
+}
+
+// History decode for sequence models: additionally fills the optional ragged
+// "hist_ids"/"hist_vals" pair into fixed [n, max_hist] outputs, zero-padded
+// and silently truncated past max_hist, with hist_len[i] = min(actual,
+// max_hist). Records without history keys decode with hist_len 0. Error
+// contract matches dfm_decode_ctr_ex, plus details -25/-26 for malformed
+// hist_ids/hist_vals wire and -27 for a length-mismatched (or half-present)
+// pair.
+long dfm_decode_ctr_hist(const uint8_t* buf, const long* offsets,
+                         const long* lengths, long n, long field_size,
+                         long max_hist, float* labels, int32_t* ids,
+                         float* vals, int32_t* hist_ids, float* hist_vals,
+                         int32_t* hist_len, long* err_detail) {
+  for (long i = 0; i < n; ++i) {
+    const uint8_t* p = buf + offsets[i];
+    long rc = parse_ctr_example(p, p + lengths[i], field_size, labels + i,
+                                ids + i * field_size, vals + i * field_size,
+                                nullptr, max_hist, hist_ids + i * max_hist,
+                                hist_vals + i * max_hist, hist_len + i);
     if (rc != 0) {
       if (err_detail) *err_detail = rc;
       return -(100 + i);

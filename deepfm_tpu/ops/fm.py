@@ -20,3 +20,30 @@ def fm_interaction(xv: jnp.ndarray) -> jnp.ndarray:
     sum_sq = jnp.square(jnp.sum(xv, axis=1))      # [B, K]
     sq_sum = jnp.sum(jnp.square(xv), axis=1)      # [B, K]
     return 0.5 * jnp.sum(sum_sq - sq_sum, axis=1)  # [B]
+
+
+def masked_softmax(scores: jnp.ndarray, mask: jnp.ndarray,
+                   axis: int = -1) -> jnp.ndarray:
+    """Softmax over ``axis`` restricted to positions where ``mask > 0``,
+    returning exact ZEROS — not NaN — on fully-masked rows.
+
+    The naive ``softmax(scores + (mask-1)*1e9)`` still divides by ~0 when a
+    row is entirely masked (an empty user history), producing NaN that
+    poisons every downstream sum. Here masked positions are excluded from
+    both the max-subtraction and the normalizer, and the all-masked case is
+    resolved with ``where(denom > 0, num/denom, 0)`` so attention over an
+    empty sequence contributes nothing instead of NaN. Shared by every
+    attention block (DIN/BST target attention).
+    """
+    valid = (mask > 0).astype(scores.dtype)
+    # Masked scores replaced with a finite -inf-ish sentinel BEFORE the
+    # max/exp: a fully-masked row then has max == sentinel and shifted == 0
+    # everywhere (never `scores - sentinel`, whose exp would overflow to
+    # inf and turn inf*0 into NaN).
+    neg = jnp.asarray(jnp.finfo(scores.dtype).min, scores.dtype)
+    masked = jnp.where(valid > 0, scores, neg)
+    shifted = masked - jnp.max(masked, axis=axis, keepdims=True)
+    num = jnp.exp(shifted) * valid
+    denom = jnp.sum(num, axis=axis, keepdims=True)
+    return jnp.where(denom > 0, num / jnp.where(denom > 0, denom, 1.0),
+                     jnp.zeros((), scores.dtype))

@@ -1,0 +1,7 @@
+"""Retrieval→ranking cascade components (README "Retrieval→ranking
+cascade"): the candidate index over twin-tower item embeddings
+(:mod:`~deepfm_tpu.rec.index`) and the two-stage serving engine that
+composes retrieve→rank over the publish/hot-swap machinery
+(:mod:`~deepfm_tpu.rec.cascade`)."""
+
+from .index import CandidateIndex  # noqa: F401

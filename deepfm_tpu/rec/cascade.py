@@ -1,0 +1,274 @@
+"""Retrieve→rank cascade serving: one artifact, two stages, one hot swap.
+
+Closes the tentpole loop (README "Retrieval→ranking cascade"): a published
+artifact dir carries THREE servables —
+
+  * the ranker (``export_serving``'s StableHLO + params, history-aware via
+    the packed-column signature),
+  * the twin towers (``towers.npz`` + ``towers_config.json``),
+  * the candidate index (``index.npz`` + ``index_meta.json``, recall@k
+    stamped).
+
+``export_cascade`` writes the retrieval files FIRST and lets
+``export_serving`` finish the dir, so the existing ``ARTIFACT_COMPLETE``
+marker certifies all three stages at once. :class:`CascadeEngine` serves
+them end-to-end: user history → user tower → index top-N → packed ranking
+batch through a :class:`~deepfm_tpu.serve.engine.ServingEngine` → top-k.
+Hot swap is ATOMIC across stages: one ``LatestWatcher`` loads ranker +
+towers + index off to the side as a single :class:`CascadeModel` and swaps
+the composite with one assignment — no request ever ranks new candidates
+with an old ranker or vice versa.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..config import Config
+from ..data import fileio
+from ..models.twin_tower import TwinTower
+from ..serve.engine import ServingEngine
+from ..serve.stats import ServingStats
+from ..utils import export as export_lib
+from .index import CandidateIndex
+
+TOWERS_FILE = "towers.npz"
+TOWERS_CONFIG_FILE = "towers_config.json"
+
+#: which feature field holds the candidate item id (the cascade convention
+#: shared with ``train_twin_tower``'s positive extraction)
+ITEM_SLOT = 0
+
+
+def _flatten_params(params) -> Tuple[list, object]:
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    return [np.asarray(x) for x in leaves], treedef
+
+
+def save_towers(tower_params, cfg: Config, out_dir: str) -> None:
+    """``towers.npz`` (leaves in tree-flatten order) + the config needed to
+    rebuild the same tree structure at load time."""
+    leaves, _ = _flatten_params(tower_params)
+    fileio.makedirs(out_dir)
+    np.savez_compressed(os.path.join(out_dir, TOWERS_FILE),
+                        **{f"p{i}": leaf for i, leaf in enumerate(leaves)})
+    with open(os.path.join(out_dir, TOWERS_CONFIG_FILE), "w") as f:
+        json.dump({"config": cfg.to_dict()}, f, indent=2)
+
+
+def load_towers(in_dir: str) -> Tuple[TwinTower, Dict]:
+    """(model, params) from :func:`save_towers` output. The param tree is
+    rebuilt from the stored config (same treedef as ``init``), so leaf
+    order — not leaf names — is the contract."""
+    with open(os.path.join(in_dir, TOWERS_CONFIG_FILE)) as f:
+        cfg = Config.from_dict(json.load(f)["config"])
+    model = TwinTower(cfg)
+    template = model.init(jax.random.PRNGKey(0))
+    _, treedef = jax.tree_util.tree_flatten(template)
+    data = np.load(os.path.join(in_dir, TOWERS_FILE))
+    leaves = [data[f"p{i}"] for i in range(len(data.files))]
+    return model, jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def export_cascade(ranker_model, ranker_state, cfg: Config, out_dir: str, *,
+                   tower_params, index: CandidateIndex,
+                   index_meta: Optional[Dict] = None) -> str:
+    """Write a complete cascade artifact: towers + index, THEN the ranker
+    export (which writes ``ARTIFACT_COMPLETE`` last — the marker certifies
+    every stage). ``index_meta`` carries measured stamps (recall@k)."""
+    fileio.makedirs(out_dir)
+    save_towers(tower_params, cfg, out_dir)
+    index.save(out_dir, extra_meta=index_meta)
+    return export_lib.export_serving(ranker_model, ranker_state, cfg, out_dir)
+
+
+def cascade_extra_export(cfg: Config, tower_params, index: CandidateIndex, *,
+                         index_meta: Optional[Dict] = None
+                         ) -> Callable[[str], None]:
+    """``Publisher(extra_export=...)`` hook: stamps the frozen retrieval
+    stage into every published ranker version (online training republishes
+    the ranker continuously; retraining towers/index is a batch job)."""
+    def hook(staging_dir: str) -> None:
+        save_towers(tower_params, cfg, staging_dir)
+        index.save(staging_dir, extra_meta=index_meta)
+    return hook
+
+
+class CascadeModel:
+    """ONE loaded artifact version: ranker + towers + index, swap-atomic.
+
+    Callable with the engine's ``(feat_ids, feat_vals)`` signature (ranking
+    only — packed columns), and carries the retrieval stage alongside so a
+    single reference assignment swaps both."""
+
+    def __init__(self, path: str, *, buckets: Sequence[int]):
+        self.path = path
+        self.rank_fn = export_lib.load_serving(path, buckets=buckets)
+        with fileio.open_stream(
+                fileio.join(path, "model_config.json"), "r") as f:
+            meta = json.load(f)
+        self.field_size = int(meta["config"]["field_size"])
+        self.hist_len = int(meta.get("history_len", 0))
+        self.tower_model, self.tower_params = load_towers(path)
+        self.index, self.index_meta = CandidateIndex.load(path)
+        self._user_fn = jax.jit(self.tower_model.user_embed)
+
+    # engine-facing predict: delegate, keep prewarm metadata visible
+    def __call__(self, feat_ids, feat_vals):
+        return self.rank_fn(feat_ids, feat_vals)
+
+    @property
+    def buckets(self):
+        return getattr(self.rank_fn, "buckets", None)
+
+    @property
+    def input_cols(self):
+        return getattr(self.rank_fn, "input_cols", None)
+
+    def user_embed(self, hist_ids: np.ndarray,
+                   hist_mask: np.ndarray) -> np.ndarray:
+        return np.asarray(self._user_fn(
+            self.tower_params, hist_ids.astype(np.int32),
+            hist_mask.astype(np.float32)))
+
+
+class CascadeEngine:
+    """Two-stage serving over the publish/hot-swap machinery.
+
+    ``recommend(hist_ids, hist_mask, feat_ids, feat_vals, k)``:
+
+      1. user tower embeds the history;
+      2. the candidate index retrieves ``retrieve_k`` item ids;
+      3. each candidate is substituted into the request's item slot
+         (field ``ITEM_SLOT``), history packed alongside, and the batch
+         ranked through the inner :class:`ServingEngine` (dynamic batching
+         + bucketed shapes + backpressure all apply);
+      4. the top ``k`` candidates by ranker probability come back.
+
+    An empty history is legal end-to-end: the user tower pools zeros (the
+    index then returns ITS notion of head items) and the ranker's attention
+    contributes exact zeros — finite probabilities, never NaN (the
+    masked-softmax regression the drill pins).
+    """
+
+    def __init__(self, publish_dir: str, *, retrieve_k: int = 50,
+                 poll_secs: float = 2.0, max_batch: int = 256,
+                 max_delay_ms: float = 5.0,
+                 buckets: Optional[Sequence[int]] = None,
+                 queue_rows: int = 0,
+                 watcher_kw: Optional[dict] = None,
+                 engine_kw: Optional[dict] = None):
+        if retrieve_k < 1:
+            raise ValueError("retrieve_k must be >= 1")
+        self.retrieve_k = int(retrieve_k)
+        resolved = tuple(buckets) if buckets is not None \
+            else export_lib.serving_buckets(max_batch)
+        stats = ServingStats()
+        wkw = {"poll_secs": poll_secs}
+        wkw.update(watcher_kw or {})  # caller overrides (tests drive polls)
+        self._watcher = export_lib.LatestWatcher(
+            publish_dir,
+            loader=lambda path: CascadeModel(path, buckets=resolved),
+            on_swap=lambda path: stats.record_swap(),
+            **wkw)
+        self._engine = ServingEngine(
+            self._watcher, max_batch=max_batch, max_delay_ms=max_delay_ms,
+            buckets=resolved, queue_rows=queue_rows, stats=stats,
+            **(engine_kw or {}))
+
+    @property
+    def watcher(self) -> export_lib.LatestWatcher:
+        return self._watcher
+
+    @property
+    def engine(self) -> ServingEngine:
+        return self._engine
+
+    @property
+    def stats(self) -> ServingStats:
+        return self._engine.stats
+
+    def current(self) -> CascadeModel:
+        model = self._watcher._fn
+        if model is None:
+            raise RuntimeError("no cascade artifact published yet")
+        return model
+
+    # ------------------------------------------------------------- serving
+    def retrieve(self, hist_ids: np.ndarray, hist_mask: np.ndarray,
+                 k: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
+        """Retrieval stage only: (item_ids [B, k], scores [B, k])."""
+        model = self.current()
+        hist_ids = np.atleast_2d(np.asarray(hist_ids, np.int32))
+        hist_mask = np.atleast_2d(np.asarray(hist_mask, np.float32))
+        users = model.user_embed(hist_ids, hist_mask)
+        return model.index.search(users, k or self.retrieve_k)
+
+    def recommend(self, hist_ids: np.ndarray, hist_mask: np.ndarray,
+                  feat_ids: np.ndarray, feat_vals: np.ndarray, *,
+                  k: int = 10, timeout: Optional[float] = 30.0
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+        """ONE user's end-to-end recommendation: (item_ids [k], probs [k]).
+
+        ``hist_ids``/``hist_mask`` [L]; ``feat_ids``/``feat_vals`` [F] the
+        request context (field ``ITEM_SLOT`` is overwritten per candidate).
+        The SAME loaded model version serves both stages of this request
+        even if a hot swap lands mid-flight.
+        """
+        model = self.current()
+        hist_ids = np.asarray(hist_ids, np.int32).reshape(1, -1)
+        hist_mask = np.asarray(hist_mask, np.float32).reshape(1, -1)
+        feat_ids = np.asarray(feat_ids, np.int32).reshape(-1)
+        feat_vals = np.asarray(feat_vals, np.float32).reshape(-1)
+        if feat_ids.shape[0] != model.field_size:
+            raise ValueError(
+                f"expected {model.field_size} context fields, "
+                f"got {feat_ids.shape[0]}")
+        users = model.user_embed(hist_ids, hist_mask)
+        cand_ids, _ = model.index.search(users, self.retrieve_k)
+        cand_ids = cand_ids[0]                              # [N]
+        n = cand_ids.shape[0]
+        ids = np.tile(feat_ids, (n, 1)).astype(np.int32)    # [N, F]
+        vals = np.tile(feat_vals, (n, 1)).astype(np.float32)
+        ids[:, ITEM_SLOT] = cand_ids
+        if model.hist_len:
+            h_ids, h_mask = _fit_history(hist_ids[0], hist_mask[0],
+                                         model.hist_len)
+            ids = np.concatenate(
+                [ids, np.tile(h_ids, (n, 1))], axis=1)
+            vals = np.concatenate(
+                [vals, np.tile(h_mask, (n, 1))], axis=1)
+        probs = np.asarray(
+            self._engine.predict(ids, vals, timeout=timeout)).reshape(-1)
+        k = min(int(k), n)
+        top = np.argsort(-probs, kind="stable")[:k]
+        return cand_ids[top], probs[top]
+
+    # ----------------------------------------------------------- lifecycle
+    def close(self, timeout: Optional[float] = None) -> None:
+        self._engine.close(timeout=timeout)
+        self._watcher.close()
+
+    def __enter__(self) -> "CascadeEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _fit_history(hist_ids: np.ndarray, hist_mask: np.ndarray,
+                 hist_len: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Pad/truncate a request's history to the artifact's trained length
+    (keep the most recent tail on truncation)."""
+    ln = hist_ids.shape[0]
+    out_ids = np.zeros((hist_len,), np.int32)
+    out_mask = np.zeros((hist_len,), np.float32)
+    n = min(ln, hist_len)
+    out_ids[:n] = hist_ids[ln - n:]
+    out_mask[:n] = hist_mask[ln - n:]
+    return out_ids, out_mask

@@ -155,15 +155,27 @@ class ServingEngine:
 
         The watcher is owned: closed with the engine, and every swap it
         performs is stamped into the engine's stats (the blackout series).
+        The watcher's loader is bucketed with the ENGINE's own ladder, so
+        the pre-swap warm-up (``LatestWatcher._warm_buckets``) compiles
+        exactly the shapes the engine will flush — the near-zero-blackout
+        contract the serving drill asserts. (The engine pads flushes to
+        the same buckets, so the inner BucketedPredict passes through.)
         """
         from ..utils import export as export_lib  # lazy: jax-heavy
         stats = kw.pop("stats", None) or ServingStats(
             kw.get("clock", time.monotonic))
+        max_batch = int(kw.get("max_batch", 256))
+        bucket_src = (kw.pop("buckets", None)
+                      or export_lib.serving_buckets(max_batch))
+        resolved = tuple(sorted({int(b) for b in bucket_src} | {max_batch}))
+        wkw = dict(watcher_kw or {})
+        wkw.setdefault("loader", lambda path: export_lib.load_serving(
+            path, buckets=resolved))
         watcher = export_lib.watch_latest(
             publish_dir, poll_secs=poll_secs,
             on_swap=lambda path: stats.record_swap(),
-            **(watcher_kw or {}))
-        engine = cls(watcher, stats=stats, **kw)
+            **wkw)
+        engine = cls(watcher, stats=stats, buckets=resolved, **kw)
         engine._watcher = watcher
         return engine
 

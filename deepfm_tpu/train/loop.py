@@ -66,11 +66,13 @@ def pad_batch(batch: Dict[str, np.ndarray], bs: int) -> Dict[str, np.ndarray]:
             for k, v in batch.items()}
 
 
-def zero_batch(field_size: int, bs: int,
-               num_labels: int = 1) -> Dict[str, np.ndarray]:
+def zero_batch(field_size: int, bs: int, num_labels: int = 1,
+               hist_len: int = 0) -> Dict[str, np.ndarray]:
     """All-zero batch with the canonical CTR schema — the single source of
     the batch keys/dtypes for dummy (lockstep filler) batches. Multi-task
-    runs carry a second label column (``label2``)."""
+    runs carry a second label column (``label2``); history runs carry the
+    fixed-shape ``hist_ids``/``hist_mask`` pair (all-masked here, so the
+    attention blocks see an empty history)."""
     batch = {
         "feat_ids": np.zeros((bs, field_size), np.int32),
         "feat_vals": np.zeros((bs, field_size), np.float32),
@@ -78,6 +80,9 @@ def zero_batch(field_size: int, bs: int,
     }
     if num_labels > 1:
         batch["label2"] = np.zeros((bs, 1), np.float32)
+    if hist_len > 0:
+        batch["hist_ids"] = np.zeros((bs, hist_len), np.int32)
+        batch["hist_mask"] = np.zeros((bs, hist_len), np.float32)
     return batch
 
 
@@ -344,11 +349,22 @@ class Trainer:
         return jnp.stack(cols[:len(self._task_names)],
                          axis=1).astype(jnp.float32)
 
+    def _hist_kwargs(self, batch):
+        """hist_ids/hist_mask forwarding for sequence models: only when the
+        model opts in (``uses_history``) AND the batch carries the columns
+        (zoo/dummy batches don't — the models then default to an empty
+        history). Trace-time pytree-key check, jit-safe."""
+        if getattr(self.model, "uses_history", False) and "hist_ids" in batch:
+            return {"hist_ids": batch["hist_ids"],
+                    "hist_mask": batch["hist_mask"]}
+        return {}
+
     def _loss_terms(self, params, model_state, batch, *, train, rng,
                     shard_axis, data_axis):
         logits, new_mstate = self.model.apply(
             params, model_state, batch["feat_ids"], batch["feat_vals"],
-            train=train, rng=rng, shard_axis=shard_axis, data_axis=data_axis)
+            train=train, rng=rng, shard_axis=shard_axis, data_axis=data_axis,
+            **self._hist_kwargs(batch))
         labels = self._batch_labels(batch)
         xent = jnp.mean(self._per_example_loss(logits, labels))
         return logits, xent, new_mstate
@@ -510,7 +526,7 @@ class Trainer:
                 logits, new_mstate = self.model.apply(
                     params, mstate, batch["feat_ids"], batch["feat_vals"],
                     train=True, rng=rng, shard_axis=shard_axis,
-                    data_axis=data_axis)
+                    data_axis=data_axis, **self._hist_kwargs(batch))
                 labels = self._batch_labels(batch)
                 xent = jnp.mean(self._per_example_loss(logits, labels))
                 return (new_mstate, xent_sum + xent), None
@@ -770,7 +786,8 @@ class Trainer:
         logits, _ = self.model.apply(
             state.params, state.model_state, batch["feat_ids"],
             batch["feat_vals"], train=False, rng=None,
-            shard_axis=shard_axis, data_axis=data_axis)
+            shard_axis=shard_axis, data_axis=data_axis,
+            **self._hist_kwargs(batch))
         if self._multitask:
             # Per-task dict accumulator: one psum-reducible histogram pair
             # per named task; the combined weighted loss mirrors training.
@@ -868,7 +885,8 @@ class Trainer:
         logits, _ = self.model.apply(
             state.params, state.model_state, batch["feat_ids"],
             batch["feat_vals"], train=False, rng=None,
-            shard_axis=shard_axis, data_axis=data_axis)
+            shard_axis=shard_axis, data_axis=data_axis,
+            **self._hist_kwargs(batch))
         if self._multitask:
             return self.model.probs_from_logits(logits)  # [B, T]
         return jax.nn.sigmoid(logits)
@@ -1687,8 +1705,11 @@ class Trainer:
 
     def _dummy_eval_batch(self, local_bs: int) -> Dict[str, np.ndarray]:
         """All-zero-weight batch: contributes nothing to AUC/loss."""
+        hist_len = (self.cfg.history_max_len
+                    if getattr(self.model, "uses_history", False) else 0)
         return {**zero_batch(self.cfg.field_size, local_bs,
-                             num_labels=len(self._task_names)),
+                             num_labels=len(self._task_names),
+                             hist_len=hist_len),
                 "weight": np.zeros((local_bs, 1), np.float32)}
 
     def evaluate(

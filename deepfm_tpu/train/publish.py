@@ -66,10 +66,16 @@ class Publisher:
                  executor: Optional[AsyncSaveExecutor] = None,
                  clock: Callable[[], float] = time.monotonic,
                  abort: Optional[Callable[[str], None]] = None,
+                 extra_export: Optional[Callable[[str], None]] = None,
                  health=None):
         self._model = model
         self._cfg = cfg
         self._dir = publish_dir
+        # Ran against the staging dir BEFORE export_serving finishes it, so
+        # the completion marker still certifies everything the hook wrote
+        # (the cascade uses this to ship towers + candidate index alongside
+        # every ranker version — rec/cascade.cascade_extra_export).
+        self._extra_export = extra_export
         self.every_steps = int(every_steps)
         self.every_secs = float(every_secs)
         self.timeout_s = float(timeout_s)
@@ -166,6 +172,8 @@ class Publisher:
         snap = _Snap()
         snap.params, snap.model_state, snap.step = params, mstate, step
 
+        if self._extra_export is not None:
+            self._extra_export(staging)
         export_lib.export_serving(self._model, snap, self._cfg, staging)
         fileio.fsync_dir(staging)
         faults_lib.check_publish_crash("before_rename")

@@ -207,6 +207,8 @@ def make_pipeline(cfg: Config, files: List[str], *, epochs: int = 1,
         stall_timeout_s=cfg.dispatch_timeout_s,
         verify_crc=cfg.verify_crc,
         num_labels=cfg.num_tasks,
+        history=cfg.history_max_len > 0,
+        history_max_len=max(1, cfg.history_max_len),
         **_fault_tolerance_kwargs(cfg),
     )
 

@@ -57,18 +57,45 @@ def _task_names(model) -> Tuple[str, ...]:
     return tuple(getattr(model, "task_names", ()) or ())
 
 
+def _serving_hist_len(model, cfg: Config) -> int:
+    """History columns in the serving signature: > 0 only for sequence
+    models exported from a history-enabled config."""
+    if getattr(model, "uses_history", False) and cfg.history_max_len > 0:
+        return int(cfg.history_max_len)
+    return 0
+
+
+def serving_input_cols(model, cfg: Config) -> int:
+    """Width of the artifact's feat_ids/feat_vals inputs. History-aware
+    artifacts use the pipeline's packed-column convention — ids carry
+    ``feat_ids ‖ hist_ids`` and vals carry ``feat_vals ‖ hist_mask``, width
+    ``field_size + history_max_len`` — so the whole engine stack (buckets,
+    padded_predict, dynamic batcher) serves them unchanged."""
+    return cfg.field_size + _serving_hist_len(model, cfg)
+
+
 def _serving_fn(model, cfg: Config) -> Callable:
     """Single-task: ``probs`` float32[B] (the reference signature, kept
     bit-for-bit). Multitask: ``{task_name: float32[B]}`` — one named
-    probability head per task, in the model's declared task order."""
+    probability head per task, in the model's declared task order.
+    History-aware models split the packed input columns back into
+    (feat, hist) before apply."""
     names = _task_names(model)
     multitask = len(names) > 1
+    hist_len = _serving_hist_len(model, cfg)
+    fs = cfg.field_size
 
     def serve(params, model_state, feat_ids, feat_vals):
+        kwargs = {}
+        if hist_len:
+            kwargs = {"hist_ids": feat_ids[:, fs:].astype(jnp.int32),
+                      "hist_mask": feat_vals[:, fs:].astype(jnp.float32)}
+            feat_ids = feat_ids[:, :fs]
+            feat_vals = feat_vals[:, :fs]
         logits, _ = model.apply(
             params, model_state, feat_ids.astype(jnp.int32),
             feat_vals.astype(jnp.float32), train=False, rng=None,
-            shard_axis=None, data_axis=None)
+            shard_axis=None, data_axis=None, **kwargs)
         if multitask:
             probs = model.probs_from_logits(logits)  # [B, T]
             return {name: probs[:, t] for t, name in enumerate(names)}
@@ -95,11 +122,13 @@ def export_serving(model, state, cfg: Config, out_dir: str) -> str:
                force=True)
     ckptr.wait_until_finished()
 
-    # 2. Serialized serving function with symbolic batch dim.
+    # 2. Serialized serving function with symbolic batch dim. History-aware
+    # models take packed columns (field_size + history_max_len wide).
     serve = _serving_fn(model, cfg)
+    in_cols = serving_input_cols(model, cfg)
     b = jax_export.symbolic_shape("b")[0]
-    ids_spec = jax.ShapeDtypeStruct((b, cfg.field_size), jnp.int32)
-    vals_spec = jax.ShapeDtypeStruct((b, cfg.field_size), jnp.float32)
+    ids_spec = jax.ShapeDtypeStruct((b, in_cols), jnp.int32)
+    vals_spec = jax.ShapeDtypeStruct((b, in_cols), jnp.float32)
     params_spec = jax.tree.map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params)
     mstate_spec = jax.tree.map(
@@ -126,7 +155,8 @@ def export_serving(model, state, cfg: Config, out_dir: str) -> str:
     # lowering failures degrade to the StableHLO+params artifact with a
     # warning, but write failures surface (same policy as the StableHLO
     # file above).
-    _export_tf_savedmodel(serve, params, model_state, cfg, out_dir)
+    _export_tf_savedmodel(serve, params, model_state, cfg, out_dir,
+                          in_cols=in_cols)
 
     # 4. Signature/config metadata. Single-task keeps the historical "prob"
     # output name; multitask artifacts advertise one output per task name.
@@ -136,12 +166,13 @@ def export_serving(model, state, cfg: Config, out_dir: str) -> str:
     meta = {
         "signature": {
             "inputs": {
-                "feat_ids": ["batch", cfg.field_size, "int32"],
-                "feat_vals": ["batch", cfg.field_size, "float32"],
+                "feat_ids": ["batch", in_cols, "int32"],
+                "feat_vals": ["batch", in_cols, "float32"],
             },
             "outputs": outputs,
         },
         "model": cfg.model,
+        "history_len": _serving_hist_len(model, cfg),
         "config": cfg.to_dict(),
         "step": int(jax.device_get(state.step)),
     }
@@ -157,7 +188,8 @@ def export_serving(model, state, cfg: Config, out_dir: str) -> str:
 
 
 def _export_tf_savedmodel(serve: Callable, params, model_state, cfg: Config,
-                          out_dir: str) -> None:
+                          out_dir: str,
+                          in_cols: Optional[int] = None) -> None:
     """Write ``<out_dir>/saved_model`` loadable by TF Serving / tf.saved_model.
 
     The serving signature mirrors the reference exactly: inputs
@@ -192,12 +224,13 @@ def _export_tf_savedmodel(serve: Callable, params, model_state, cfg: Config,
             # single-task keeps the reference's "prob" key.
             return out if isinstance(out, dict) else {"prob": out}
 
+        cols = in_cols if in_cols is not None else cfg.field_size
         module.f = tf.function(
             _sig_out,
             input_signature=[
-                tf.TensorSpec([None, cfg.field_size], tf.int64,
+                tf.TensorSpec([None, cols], tf.int64,
                               name="feat_ids"),
-                tf.TensorSpec([None, cfg.field_size], tf.float32,
+                tf.TensorSpec([None, cols], tf.float32,
                               name="feat_vals"),
             ])
         # Trace now: lowering errors belong to this guard, not to save().
@@ -362,8 +395,14 @@ def load_serving(artifact_dir: str, *,
             if isinstance(out, dict):
                 return {k: np.asarray(v) for k, v in out.items()}
             return np.asarray(out)
+    # Input width from the signature metadata: what a pre-warm caller (the
+    # hot-swap watcher) needs to drive every bucket shape before the swap.
+    in_cols = int(meta["signature"]["inputs"]["feat_ids"][1])
+    serve.input_cols = in_cols
     if buckets is not None:
-        return BucketedPredict(serve, buckets)
+        wrapped = BucketedPredict(serve, buckets)
+        wrapped.input_cols = in_cols
+        return wrapped
     return serve
 
 
@@ -409,16 +448,22 @@ class LatestWatcher:
                  on_swap: Optional[Callable[[str], None]] = None,
                  loader: Callable[[str], Callable] = load_serving,
                  start: bool = True,
+                 prewarm: bool = True,
                  sleep: Optional[Callable[[float], None]] = None):
         self._publish_dir = publish_dir
         self._poll_secs = float(poll_secs)
         self._on_swap = on_swap
         self._loader = loader
+        self._prewarm = bool(prewarm)
         self._stop = threading.Event()
         self._sleep = sleep if sleep is not None else self._stop.wait
         self._fn: Optional[Callable] = None
         self.current_path: Optional[str] = None
         self.swap_count = 0
+        # Buckets compiled off-thread before each swap (observability for
+        # the blackout drill: prewarmed > 0 means the first post-swap
+        # request of any bucket shape hits a warm compile cache).
+        self.prewarmed_buckets = 0
         # Failed swap attempts (torn/marker-less/vanished artifact seen at
         # LATEST): the current model stayed live each time. A counter, not
         # just a warning — a serving drill asserting "zero dropped requests
@@ -438,6 +483,8 @@ class LatestWatcher:
             return False
         try:
             fn = self._loader(path)
+            if self._prewarm:
+                self._warm_buckets(fn)
         except (ArtifactIncomplete, OSError, ValueError) as e:
             self.swap_failures += 1
             ulog.warning(f"hot-swap to {path} deferred ({e}); "
@@ -449,6 +496,23 @@ class LatestWatcher:
         if self._on_swap is not None:
             self._on_swap(path)
         return True
+
+    def _warm_buckets(self, fn: Callable) -> None:
+        """Drive every serving bucket through the NEW function before it is
+        swapped in, still off to the side: each bucket's predict program
+        compiles here, on the watcher thread, so the swap costs live
+        traffic one pointer assignment instead of len(buckets) compiles
+        (the near-zero-blackout property the serving drill asserts).
+        Needs a bucketed loader result that advertises its input width
+        (``load_serving(buckets=...)`` does); anything else warms nothing."""
+        buckets = getattr(fn, "buckets", None)
+        cols = getattr(fn, "input_cols", None)
+        if not buckets or not cols:
+            return
+        for b in buckets:
+            fn(np.zeros((int(b), int(cols)), np.int32),
+               np.zeros((int(b), int(cols)), np.float32))
+            self.prewarmed_buckets += 1
 
     def _run(self) -> None:
         while not self._stop.is_set():

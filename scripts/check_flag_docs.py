@@ -1,20 +1,25 @@
 #!/usr/bin/env python
-"""Fail if any ``Config`` field is undocumented in docs/MIGRATION.md.
+"""Fail if Config flags and docs/MIGRATION.md drift — in EITHER direction.
 
 Every dataclass field of :class:`deepfm_tpu.config.Config` is a ``--flag``
 (argparse auto-generates the parser from the dataclass), and MIGRATION.md is
 the flag contract page — the one place a reference user looks up every knob.
-This check keeps the two from drifting: adding a Config field without a
-MIGRATION row breaks tier-1 (``tests/test_flag_docs.py`` wraps this).
+Two drift directions, both break tier-1 (``tests/test_flag_docs.py``):
 
-A field counts as documented if MIGRATION.md mentions it as ``--name`` or
-`` `name` `` (backticked).
+* **missing**: a Config field MIGRATION.md never mentions (as ``--name`` or
+  backticked `` `name` ``) — a new knob shipped undocumented;
+* **stale**: a ``--name`` token in MIGRATION.md that is NOT a Config field —
+  a deleted/renamed flag the doc still advertises. The doc's convention
+  makes this checkable: current flags are written ``--name``; the
+  reference repo's old names are backticked without dashes, so they don't
+  trip the scan.
 
-Usage: python scripts/check_flag_docs.py  (exit 0 = all documented)
+Usage: python scripts/check_flag_docs.py  (exit 0 = no drift)
 """
 
 import dataclasses
 import os
+import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -22,28 +27,57 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DOC = os.path.join(REPO, "docs", "MIGRATION.md")
 
+#: ``--tokens`` in MIGRATION.md that are deliberately not Config fields:
+#: the generic ``--flag value`` syntax placeholder and the standalone
+#: converter tool's own CLI (``tools/libsvm_to_tfrecord.py``).
+NON_CONFIG_TOKENS = frozenset({"flag", "input", "output", "shards"})
+
+
+def _doc(doc_text):
+    if doc_text is None:
+        with open(DOC, encoding="utf-8") as f:
+            doc_text = f.read()
+    return doc_text
+
 
 def missing_flags(doc_text=None):
     """Config field names not mentioned in MIGRATION.md."""
     from deepfm_tpu.config import Config
-    if doc_text is None:
-        with open(DOC, encoding="utf-8") as f:
-            doc_text = f.read()
+    doc_text = _doc(doc_text)
     return [f.name for f in dataclasses.fields(Config)
             if f"--{f.name}" not in doc_text
             and f"`{f.name}`" not in doc_text]
 
 
+def stale_flags(doc_text=None):
+    """``--name`` tokens in MIGRATION.md that no longer exist in Config
+    (deleted or renamed flags the doc still references)."""
+    from deepfm_tpu.config import Config
+    doc_text = _doc(doc_text)
+    fields = {f.name for f in dataclasses.fields(Config)}
+    referenced = set(re.findall(r"--([A-Za-z0-9_]+)", doc_text))
+    return sorted(referenced - fields - NON_CONFIG_TOKENS)
+
+
 def main():
     missing = missing_flags()
+    stale = stale_flags()
     if missing:
         print(f"docs/MIGRATION.md is missing {len(missing)} flag(s):")
         for name in missing:
             print(f"  --{name}")
         print("add a row (as `--name` or backticked `name`) to "
               "docs/MIGRATION.md")
+    if stale:
+        print(f"docs/MIGRATION.md references {len(stale)} flag(s) that no "
+              "longer exist in Config:")
+        for name in stale:
+            print(f"  --{name}")
+        print("fix or drop the row (old reference-repo names belong in "
+              "backticks without dashes)")
+    if missing or stale:
         return 1
-    print("all Config flags documented in docs/MIGRATION.md")
+    print("docs/MIGRATION.md and Config flags are in sync (both directions)")
     return 0
 
 

@@ -13,10 +13,15 @@ The executable acceptance check for the TPU-native serving runtime
      time. The engine must hot-swap through >= 2 version changes (beyond
      the initial load) with ZERO dropped or failed requests and zero
      failed swaps — and every returned prob finite and in [0, 1].
-  3. **Bucket parity.** After the run, the final artifact is loaded twice
+  3. **Near-zero blackout.** The watcher pre-warms every serving bucket
+     off-thread before each one-assignment swap, so the measured
+     swap-to-next-flush blackout must stay under ``MAX_BLACKOUT_MS``
+     (the pre-warm baseline was 239 ms of post-swap compiles,
+     SERVING_r01.json) and ``prewarmed_buckets`` must be > 0.
+  4. **Bucket parity.** After the run, the final artifact is loaded twice
      — raw and bucket-padded — and the padded outputs must be BIT-EQUAL
      to the unpadded call row-for-row across non-bucket batch sizes.
-  4. **Report.** p50/p99 latency, QPS, batch occupancy (> 0 required),
+  5. **Report.** p50/p99 latency, QPS, batch occupancy (> 0 required),
      and measured swap blackout go to ``SERVING_r0N.json`` at the repo
      root (next free N).
 
@@ -48,6 +53,11 @@ PUBLISH_EVERY = 4        # versions at steps 4, 8, 12, 16
 N_CLIENTS = 3
 MAX_REQ_ROWS = 24
 MIN_SWAPS = 3            # initial load + >= 2 hot swaps
+# Worst-case swap-to-next-flush gap with bucket pre-warm. The pre-warm
+# baseline measured 239 ms (SERVING_r01.json) — post-swap bucket compiles
+# on the serving path; with the watcher warming every bucket off-thread
+# the remaining gap is scheduling noise, bounded well below that.
+MAX_BLACKOUT_MS = 100.0
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -226,6 +236,12 @@ def run_drill(workdir=None, report_path=None, verbose=True):
         and summary["batch_occupancy_pct"] > 0, summary
     assert summary["serving_p50_ms"] is not None \
         and summary["serving_p99_ms"] is not None, summary
+    # Near-zero blackout: every bucket was compiled off-thread before the
+    # swap assignment, so no post-swap request pays a compile.
+    assert watcher.prewarmed_buckets > 0, "watcher never pre-warmed a bucket"
+    assert summary["swap_blackout_ms"] is not None \
+        and summary["swap_blackout_ms"] < MAX_BLACKOUT_MS, \
+        f"swap blackout {summary['swap_blackout_ms']}ms >= {MAX_BLACKOUT_MS}ms"
     _assert_bucket_parity(final_artifact)
 
     report = {
@@ -241,6 +257,7 @@ def run_drill(workdir=None, report_path=None, verbose=True):
         "serving_overloads": summary["serving_overloads"],
         "hot_swaps": swaps,
         "swap_failures": swap_failures,
+        "prewarmed_buckets": watcher.prewarmed_buckets,
         "versions_published": versions,
         "clients": N_CLIENTS,
         "load_kind": "synthetic-closed-loop",

@@ -1,5 +1,6 @@
-"""Every ``Config`` field must be documented in docs/MIGRATION.md — a new
-flag without its migration row fails tier-1, not code review."""
+"""Config flags and docs/MIGRATION.md must agree in BOTH directions — a new
+flag without its migration row, or a migration row still advertising a
+deleted flag, fails tier-1, not code review."""
 
 import os
 import sys
@@ -21,3 +22,26 @@ def test_checker_detects_missing_flag():
     # The checker itself must not silently pass on an empty doc.
     missing = check_flag_docs.missing_flags(doc_text="nothing documented")
     assert "batch_size" in missing and "online_mode" in missing
+
+
+def test_no_stale_flags_in_migration_doc():
+    stale = check_flag_docs.stale_flags()
+    assert stale == [], (
+        f"docs/MIGRATION.md references deleted flags: {stale} — fix or drop "
+        "the row (see scripts/check_flag_docs.py)")
+
+
+def test_checker_detects_stale_flag():
+    # A row advertising a flag Config no longer has must be caught.
+    doc = "use `--batch_size` and `--definitely_deleted_flag` together"
+    assert check_flag_docs.stale_flags(doc_text=doc) == [
+        "definitely_deleted_flag"]
+
+
+def test_stale_check_ignores_reference_names_and_tool_flags():
+    # Old reference-repo names are backticked WITHOUT dashes — not stale —
+    # and the converter tool's own CLI is allowlisted.
+    doc = ("`training_data_dir` maps to `--data_dir`; "
+           "converter: `--input a --output b --shards 4`; "
+           "syntax is `--flag value`")
+    assert check_flag_docs.stale_flags(doc_text=doc) == []

@@ -342,3 +342,215 @@ class TestAssembleSpans:
         assert l_f.tobytes() == l_s.tobytes()
         assert i_f.tobytes() == i_s.tobytes()
         assert v_f.tobytes() == v_s.tobytes()
+
+
+class TestHistoryDecode:
+    """Native history decode (``dfm_decode_ctr_hist``): golden-pinned bytes,
+    bit-parity with the Python codec mirror on every path (multi-record,
+    empty, truncated), typed bad-record codes (-25/-26/-27), and the
+    stale-.so fallback contract (``has_hist()``)."""
+
+    MAX_LEN = 5
+
+    @pytest.fixture(scope="class")
+    def hist_file(self, tmp_path_factory):
+        d = tmp_path_factory.mktemp("native_hist")
+        [path] = libsvm.generate_synthetic_ctr(
+            str(d), num_files=1, examples_per_file=200,
+            feature_size=500, field_size=7, seed=11, history=self.MAX_LEN)
+        return path
+
+    def _python_mirror(self, records, field_size, max_len):
+        n = len(records)
+        labels = np.empty(n, np.float32)
+        ids = np.empty((n, field_size), np.int32)
+        vals = np.empty((n, field_size), np.float32)
+        hid = np.zeros((n, max_len), np.int32)
+        hval = np.zeros((n, max_len), np.float32)
+        hlen = np.zeros(n, np.int32)
+        for i, rec in enumerate(records):
+            lab, rid, rval, h_i, h_v, h_n = \
+                example_codec.decode_ctr_example_hist(rec, field_size, max_len)
+            labels[i], ids[i], vals[i] = lab, rid.astype(np.int32), rval
+            hid[i], hval[i], hlen[i] = h_i, h_v, h_n
+        return labels, ids, vals, hid, hval, hlen
+
+    @pytest.mark.skipif(not loader.has_hist(),
+                        reason="stale .so without history entry")
+    def test_matches_python_mirror_bit_identical(self, hist_file):
+        records = tfrecord.read_all_records(hist_file)
+        native = loader.decode_batch_hist(records, 7, self.MAX_LEN)
+        mirror = self._python_mirror(records, 7, self.MAX_LEN)
+        for a, b in zip(native, mirror):
+            assert a.tobytes() == b.tobytes()
+        # the synthetic stream's click-gated histories are actually ragged:
+        # some empty, some full (otherwise this parity test proves little)
+        hlen = native[5]
+        assert hlen.min() == 0 and hlen.max() == self.MAX_LEN
+
+    @pytest.mark.skipif(not loader.has_hist(),
+                        reason="stale .so without history entry")
+    def test_golden_pinned_record(self):
+        """Hand-built record with known history -> pinned decoded arrays,
+        through BOTH decoders."""
+        rec = example_codec.encode_ctr_example(
+            1.0, np.array([3, 1, 4, 1, 5], np.int64),
+            np.array([0.5, -1.0, 2.0, 0.0, 1.5], np.float32),
+            hist_ids=np.array([7, 9, 11], np.int64))
+        for decode in (
+                lambda: loader.decode_batch_hist([rec], 5, 4),
+                lambda: self._python_mirror([rec], 5, 4)):
+            labels, ids, vals, hid, hval, hlen = decode()
+            assert labels[0] == 1.0
+            np.testing.assert_array_equal(ids[0], [3, 1, 4, 1, 5])
+            np.testing.assert_allclose(vals[0], [0.5, -1.0, 2.0, 0.0, 1.5])
+            np.testing.assert_array_equal(hid[0], [7, 9, 11, 0])
+            np.testing.assert_array_equal(hval[0], [1.0, 1.0, 1.0, 0.0])
+            assert hlen[0] == 3
+
+    @pytest.mark.skipif(not loader.has_hist(),
+                        reason="stale .so without history entry")
+    def test_absent_history_decodes_empty(self):
+        """A plain single-label record (no hist keys) stays decodable:
+        hist_len 0, all-zero columns — old files feed sequence models."""
+        rec = example_codec.encode_ctr_example(
+            0.0, np.arange(3, dtype=np.int64), np.ones(3, np.float32))
+        _, _, _, hid, hval, hlen = loader.decode_batch_hist([rec], 3, 4)
+        assert hlen[0] == 0
+        np.testing.assert_array_equal(hid[0], np.zeros(4))
+        np.testing.assert_array_equal(hval[0], np.zeros(4))
+
+    @pytest.mark.skipif(not loader.has_hist(),
+                        reason="stale .so without history entry")
+    def test_truncation_keeps_head(self):
+        """History longer than max_len truncates to the first max_len
+        entries, identically in both decoders."""
+        rec = example_codec.encode_ctr_example(
+            1.0, np.arange(3, dtype=np.int64), np.ones(3, np.float32),
+            hist_ids=np.array([10, 20, 30, 40, 50, 60], np.int64),
+            hist_vals=np.array([1, 1, 1, 1, 1, 1], np.float32))
+        n_ids, n_hid, n_hlen = (lambda r: (r[1], r[3], r[5]))(
+            loader.decode_batch_hist([rec], 3, 4))
+        p_ids, p_hid, p_hlen = (lambda r: (r[1], r[3], r[5]))(
+            self._python_mirror([rec], 3, 4))
+        np.testing.assert_array_equal(n_hid[0], [10, 20, 30, 40])
+        assert n_hlen[0] == 4
+        assert n_hid.tobytes() == p_hid.tobytes()
+        assert n_hlen.tobytes() == p_hlen.tobytes()
+
+    # -- typed bad-record codes ---------------------------------------------
+
+    def _raw_example(self, features):
+        """Assemble an Example from raw Feature BYTES (lets a test plant
+        malformed wire inside one feature)."""
+        feat_map = bytearray()
+        for name, feat in features.items():
+            entry = bytearray()
+            example_codec._write_len_delimited(1, name.encode(), entry)
+            example_codec._write_len_delimited(2, feat, entry)
+            example_codec._write_len_delimited(1, bytes(entry), feat_map)
+        out = bytearray()
+        example_codec._write_len_delimited(1, bytes(feat_map), out)
+        return bytes(out)
+
+    def _base_features(self):
+        return {
+            "label": example_codec.encode_feature([1.0], "float"),
+            "ids": example_codec.encode_feature([1, 2, 3], "int64"),
+            "values": example_codec.encode_feature([1.0, 1.0, 1.0], "float"),
+        }
+
+    @pytest.mark.skipif(not loader.has_hist(),
+                        reason="stale .so without history entry")
+    def test_malformed_hist_ids_wire_reports_25(self):
+        feats = self._base_features()
+        bad = bytearray()
+        # Feature { int64_list = 3 } whose payload is a truncated varint
+        example_codec._write_len_delimited(3, b"\x80", bad)
+        feats["hist_ids"] = bytes(bad)
+        feats["hist_vals"] = example_codec.encode_feature([1.0], "float")
+        with pytest.raises(ValueError, match="malformed 'hist_ids'"):
+            loader.decode_batch_hist([self._raw_example(feats)], 3, 4)
+
+    @pytest.mark.skipif(not loader.has_hist(),
+                        reason="stale .so without history entry")
+    def test_malformed_hist_vals_wire_reports_26(self):
+        feats = self._base_features()
+        feats["hist_ids"] = example_codec.encode_feature([5], "int64")
+        bad = bytearray()
+        example_codec._write_len_delimited(2, b"\x80", bad)
+        feats["hist_vals"] = bytes(bad)
+        with pytest.raises(ValueError, match="malformed 'hist_vals'"):
+            loader.decode_batch_hist([self._raw_example(feats)], 3, 4)
+
+    @pytest.mark.skipif(not loader.has_hist(),
+                        reason="stale .so without history entry")
+    def test_length_mismatch_reports_27_with_record_index(self):
+        good = example_codec.encode_ctr_example(
+            1.0, np.arange(3, dtype=np.int64), np.ones(3, np.float32),
+            hist_ids=np.array([5], np.int64))
+        feats = self._base_features()
+        feats["hist_ids"] = example_codec.encode_feature([5, 6, 7], "int64")
+        feats["hist_vals"] = example_codec.encode_feature([1.0, 1.0], "float")
+        with pytest.raises(ValueError, match="record 1.*lengths differ"):
+            loader.decode_batch_hist([good, self._raw_example(feats)], 3, 4)
+
+    @pytest.mark.skipif(not loader.has_hist(),
+                        reason="stale .so without history entry")
+    def test_half_present_pair_reports_27(self):
+        feats = self._base_features()
+        feats["hist_ids"] = example_codec.encode_feature([5, 6], "int64")
+        with pytest.raises(ValueError, match="lengths differ"):
+            loader.decode_batch_hist([self._raw_example(feats)], 3, 4)
+
+    def test_python_mirror_rejects_mismatch_too(self):
+        feats = self._base_features()
+        feats["hist_ids"] = example_codec.encode_feature([5, 6], "int64")
+        with pytest.raises(ValueError, match="history length mismatch"):
+            example_codec.decode_ctr_example_hist(
+                self._raw_example(feats), 3, 4)
+
+    # -- stale-.so fallback --------------------------------------------------
+
+    @pytest.mark.skipif(not loader.has_hist(),
+                        reason="stale .so without history entry")
+    def test_stale_so_falls_back_bit_identical(self, hist_file, monkeypatch):
+        """A cached .so predating the history entry must degrade to the
+        Python codec mirror with identical bytes (the has_hist() probe
+        contract, same discipline as the fused-assemble fallback)."""
+        records = tfrecord.read_all_records(hist_file)[:50]
+        native = loader.decode_batch_hist(records, 7, self.MAX_LEN)
+        real = loader._load()
+
+        class _StaleLib:
+            def __getattr__(self, name):
+                if name == "dfm_decode_ctr_hist":
+                    raise AttributeError(name)
+                return getattr(real, name)
+
+        stale = _StaleLib()
+        monkeypatch.setattr(loader, "_load", lambda: stale)
+        assert not loader.has_hist()
+        fallback = loader.decode_batch_hist(records, 7, self.MAX_LEN)
+        for a, b in zip(native, fallback):
+            assert a.tobytes() == b.tobytes()
+
+    @pytest.mark.skipif(not loader.has_hist(),
+                        reason="stale .so without history entry")
+    def test_pipeline_history_native_matches_python(self, hist_file):
+        """End of the chain: CtrPipeline(history=True) emits identical
+        batches (packed-then-split hist columns included) through the native
+        and pure-Python decoders."""
+        kw = dict(field_size=7, batch_size=40, shuffle=False,
+                  prefetch_batches=0, history=True,
+                  history_max_len=self.MAX_LEN)
+        p = pipeline.CtrPipeline([hist_file], use_native_decoder=True, **kw)
+        q = pipeline.CtrPipeline([hist_file], use_native_decoder=False, **kw)
+        n = 0
+        for bn, bp in zip(p, q):
+            for key in ("label", "feat_ids", "feat_vals",
+                        "hist_ids", "hist_mask"):
+                np.testing.assert_array_equal(bn[key], bp[key], err_msg=key)
+            assert bn["hist_ids"].shape[1] == self.MAX_LEN
+            n += 1
+        assert n == 5

@@ -1,0 +1,262 @@
+"""Sequence-model tests: masked_softmax NumPy oracle, DIN/BST target
+attention, and the empty-history contract.
+
+The masked-softmax section is the satellite regression suite for
+``ops/fm.py``: softmax restricted to mask>0 positions must match a direct
+NumPy oracle and return EXACT ZEROS (never NaN) on fully-masked rows — the
+bug class that poisons every attention sum downstream of an empty history.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepfm_tpu.config import Config
+from deepfm_tpu.models import get_model
+from deepfm_tpu.models.sequence import (
+    _empty_history, init_target_attention, target_attention)
+from deepfm_tpu.ops import fm as fm_ops
+
+FIELD = 5
+HIST = 4
+
+
+def _cfg(**kw):
+    base = dict(
+        feature_size=100, field_size=FIELD, embedding_size=4,
+        deep_layers="8,4", dropout="1.0,1.0", batch_size=8,
+        compute_dtype="float32", l2_reg=1e-3, batch_norm=False,
+        model="din", history_max_len=HIST)
+    base.update(kw)
+    return Config(**base)
+
+
+def _hist_batch(cfg, n=8, seed=0, empty_rows=()):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, cfg.feature_size,
+                       size=(n, cfg.field_size)).astype(np.int32)
+    vals = rng.normal(size=(n, cfg.field_size)).astype(np.float32)
+    hist_ids = rng.integers(1, cfg.feature_size,
+                            size=(n, HIST)).astype(np.int32)
+    lens = rng.integers(1, HIST + 1, size=n)
+    hist_mask = (np.arange(HIST)[None, :] < lens[:, None]).astype(np.float32)
+    for r in empty_rows:
+        hist_mask[r] = 0.0
+        hist_ids[r] = 0
+    return ids, vals, hist_ids, hist_mask
+
+
+# ---------------------------------------------------------------------------
+# masked_softmax vs NumPy oracle
+# ---------------------------------------------------------------------------
+
+def _np_masked_softmax(scores, mask, axis=-1):
+    """Direct oracle: softmax over mask>0 positions, zeros elsewhere; a row
+    with no valid position is all zeros."""
+    scores = np.asarray(scores, np.float64)
+    valid = np.broadcast_to(np.asarray(mask) > 0, scores.shape)
+    out = np.zeros_like(scores)
+    flat_s = scores.reshape(-1, scores.shape[axis]) if axis == -1 \
+        else np.moveaxis(scores, axis, -1).reshape(-1, scores.shape[axis])
+    flat_v = valid.reshape(flat_s.shape) if axis == -1 \
+        else np.moveaxis(valid, axis, -1).reshape(flat_s.shape)
+    flat_o = np.zeros_like(flat_s)
+    for i in range(flat_s.shape[0]):
+        sel = flat_v[i]
+        if not sel.any():
+            continue
+        e = np.exp(flat_s[i][sel] - flat_s[i][sel].max())
+        flat_o[i][sel] = e / e.sum()
+    out = flat_o.reshape(scores.shape) if axis == -1 \
+        else np.moveaxis(flat_o.reshape(np.moveaxis(scores, axis, -1).shape),
+                         -1, axis)
+    return out
+
+
+class TestMaskedSoftmax:
+    def test_matches_numpy_oracle(self):
+        rng = np.random.default_rng(0)
+        scores = rng.normal(size=(6, 9)).astype(np.float32) * 3
+        mask = (rng.random((6, 9)) < 0.6).astype(np.float32)
+        got = np.asarray(fm_ops.masked_softmax(
+            jnp.asarray(scores), jnp.asarray(mask)))
+        np.testing.assert_allclose(got, _np_masked_softmax(scores, mask),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_all_masked_rows_are_exact_zeros(self):
+        """THE regression: an empty history must contribute exact zeros,
+        not NaN (naive softmax(scores - 1e9) divides by ~0 here)."""
+        scores = jnp.asarray([[5.0, -3.0, 1.0], [0.0, 0.0, 0.0]])
+        mask = jnp.zeros((2, 3))
+        out = np.asarray(fm_ops.masked_softmax(scores, mask))
+        np.testing.assert_array_equal(out, np.zeros((2, 3)))
+
+    def test_full_mask_equals_plain_softmax(self):
+        rng = np.random.default_rng(1)
+        scores = rng.normal(size=(4, 7)).astype(np.float32)
+        got = fm_ops.masked_softmax(jnp.asarray(scores), jnp.ones((4, 7)))
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(jax.nn.softmax(scores, axis=-1)),
+            rtol=1e-6)
+
+    def test_valid_rows_sum_to_one(self):
+        rng = np.random.default_rng(2)
+        scores = rng.normal(size=(5, 6)).astype(np.float32)
+        mask = np.ones((5, 6), np.float32)
+        mask[:, 4:] = 0.0
+        out = np.asarray(fm_ops.masked_softmax(
+            jnp.asarray(scores), jnp.asarray(mask)))
+        np.testing.assert_allclose(out.sum(axis=-1), 1.0, rtol=1e-6)
+        assert np.all(out[:, 4:] == 0.0)
+
+    def test_extreme_scores_stay_finite(self):
+        """Large masked-out scores must not overflow through exp: the
+        sentinel substitution happens BEFORE the max/exp."""
+        scores = jnp.asarray([[1e4, -1e4, 2.0]])
+        mask = jnp.asarray([[0.0, 0.0, 1.0]])
+        out = np.asarray(fm_ops.masked_softmax(scores, mask))
+        np.testing.assert_allclose(out, [[0.0, 0.0, 1.0]])
+
+    def test_broadcast_mask_3d(self):
+        """The BST usage: scores [B, M, L] against mask [B, 1, L]."""
+        rng = np.random.default_rng(3)
+        scores = rng.normal(size=(2, 3, 5)).astype(np.float32)
+        mask = (rng.random((2, 1, 5)) < 0.5).astype(np.float32)
+        got = np.asarray(fm_ops.masked_softmax(
+            jnp.asarray(scores), jnp.asarray(mask)))
+        want = _np_masked_softmax(scores, np.broadcast_to(mask, scores.shape))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_gradient_finite_through_all_masked_row(self):
+        def f(s):
+            return jnp.sum(fm_ops.masked_softmax(s, jnp.zeros_like(s)))
+        g = jax.grad(f)(jnp.asarray([[1.0, 2.0, 3.0]]))
+        assert np.all(np.isfinite(np.asarray(g)))
+
+
+# ---------------------------------------------------------------------------
+# Target attention block
+# ---------------------------------------------------------------------------
+
+class TestTargetAttention:
+    def _setup(self, b=3, l=4, k=4, seed=0):
+        att = init_target_attention(jax.random.PRNGKey(seed), k, 8)
+        rng = np.random.default_rng(seed)
+        query = rng.normal(size=(b, k)).astype(np.float32)
+        keys = rng.normal(size=(b, l, k)).astype(np.float32)
+        return att, jnp.asarray(query), jnp.asarray(keys)
+
+    def test_empty_history_returns_exact_zeros(self):
+        att, query, keys = self._setup()
+        out = target_attention(att, query, keys, jnp.zeros((3, 4)))
+        np.testing.assert_array_equal(np.asarray(out), np.zeros((3, 4)))
+
+    def test_masked_positions_do_not_affect_output(self):
+        att, query, keys = self._setup()
+        mask = jnp.asarray(np.array([[1, 1, 0, 0]] * 3, np.float32))
+        out1 = target_attention(att, query, keys, mask)
+        poisoned = keys.at[:, 2:, :].set(1e6)  # garbage in masked slots
+        out2 = target_attention(att, query, poisoned, mask)
+        np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+    def test_output_is_convex_combination_of_keys(self):
+        """With one valid position the output IS that key vector."""
+        att, query, keys = self._setup()
+        mask = jnp.asarray(np.array([[0, 0, 1, 0]] * 3, np.float32))
+        out = target_attention(att, query, keys, mask)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(keys[:, 2, :]), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# DIN / BST models
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", ["din", "bst"])
+class TestSequenceModels:
+    def test_uses_history_flag(self, name):
+        model = get_model(_cfg(model=name))
+        assert model.uses_history is True
+
+    def test_no_kwargs_equals_all_masked_history(self, name):
+        """apply() without history kwargs defaults to an empty history whose
+        attention contributes exact zeros — bit-identical to passing an
+        explicit all-masked [B, L] history."""
+        cfg = _cfg(model=name)
+        model = get_model(cfg)
+        params, state = model.init(jax.random.PRNGKey(0))
+        ids, vals, hist_ids, _ = _hist_batch(cfg)
+        l_none, _ = model.apply(params, state, ids, vals, train=False)
+        l_empty, _ = model.apply(
+            params, state, ids, vals, train=False,
+            hist_ids=hist_ids, hist_mask=np.zeros_like(
+                hist_ids, np.float32))
+        np.testing.assert_array_equal(np.asarray(l_none), np.asarray(l_empty))
+
+    def test_history_changes_logits(self, name):
+        cfg = _cfg(model=name)
+        model = get_model(cfg)
+        params, state = model.init(jax.random.PRNGKey(0))
+        ids, vals, hist_ids, hist_mask = _hist_batch(cfg)
+        l_none, _ = model.apply(params, state, ids, vals, train=False)
+        l_hist, _ = model.apply(params, state, ids, vals, train=False,
+                                hist_ids=hist_ids, hist_mask=hist_mask)
+        assert np.all(np.isfinite(np.asarray(l_hist)))
+        assert not np.allclose(np.asarray(l_none), np.asarray(l_hist))
+
+    def test_mixed_empty_rows_finite(self, name):
+        """A batch mixing real and empty histories must be finite in every
+        row (the masked-softmax contract through the full model)."""
+        cfg = _cfg(model=name)
+        model = get_model(cfg)
+        params, state = model.init(jax.random.PRNGKey(0))
+        ids, vals, hist_ids, hist_mask = _hist_batch(cfg, empty_rows=(0, 3))
+        logits, _ = model.apply(params, state, ids, vals, train=False,
+                                hist_ids=hist_ids, hist_mask=hist_mask)
+        assert np.all(np.isfinite(np.asarray(logits)))
+
+    def test_grads_flow_to_attention_and_embeddings(self, name):
+        cfg = _cfg(model=name)
+        model = get_model(cfg)
+        params, state = model.init(jax.random.PRNGKey(0))
+        ids, vals, hist_ids, hist_mask = _hist_batch(cfg)
+        labels = (np.arange(ids.shape[0]) % 2).astype(np.float32)
+
+        def loss(p):
+            logits, _ = model.apply(p, state, ids, vals, train=False,
+                                    hist_ids=hist_ids, hist_mask=hist_mask)
+            return jnp.mean(
+                jnp.maximum(logits, 0) - logits * labels
+                + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+        grads = jax.grad(loss)(params)
+        for leaf in jax.tree.leaves(grads):
+            assert np.isfinite(np.asarray(leaf)).all()
+        assert float(np.abs(np.asarray(grads["fm_v"])).sum()) > 0.0
+        att_mass = sum(float(np.abs(np.asarray(g)).sum())
+                       for g in jax.tree.leaves(grads["att"]))
+        assert att_mass > 0.0
+
+
+class TestBSTPositionTable:
+    def test_rows_sized_by_history_max_len(self):
+        model = get_model(_cfg(model="bst", history_max_len=7))
+        params, _ = model.init(jax.random.PRNGKey(0))
+        assert params["att"]["pos"].shape == (7, 4)
+
+    def test_overlong_history_rejected(self):
+        cfg = _cfg(model="bst", history_max_len=3)
+        model = get_model(cfg)
+        params, state = model.init(jax.random.PRNGKey(0))
+        ids, vals, hist_ids, hist_mask = _hist_batch(cfg)  # L = 4 > 3 rows
+        with pytest.raises(ValueError, match="position table"):
+            model.apply(params, state, ids, vals, train=False,
+                        hist_ids=hist_ids, hist_mask=hist_mask)
+
+
+class TestEmptyHistoryHelper:
+    def test_shapes(self):
+        ids, mask = _empty_history(5)
+        assert ids.shape == (5, 1) and ids.dtype == jnp.int32
+        assert mask.shape == (5, 1) and float(jnp.sum(mask)) == 0.0
