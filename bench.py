@@ -586,6 +586,61 @@ def multitask_series() -> dict:
     return out
 
 
+def production_day_series() -> dict:
+    """Closed-loop production-day drill (``scripts/production_drill.py``
+    smoke variant): the serve->log->join->train->publish loop in one
+    process, with the seeded publish crash live. Reports the loop's
+    operational envelope — end-to-end staleness percentiles, request loss
+    across hot swaps, serving latency under diurnal load, and the
+    windowed online-vs-frozen AUC — from ONE drill run.
+
+    Honesty fields mirror the serving series: ``device_kind`` names the
+    chip; ``load_kind`` labels the traffic as the seeded diurnal synthetic
+    plan (not a production trace); ``baseline_kind`` labels the AUC
+    comparator as the frozen bootstrap artifact, not a tuned champion.
+    ``chaos_fingerprint`` pins the exact fault plan the numbers were
+    measured under."""
+    import sys as _sys
+
+    import jax
+
+    _sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts"))
+    import shutil
+    import tempfile
+
+    import production_drill
+
+    tmp = tempfile.mkdtemp(prefix="bench_production_")
+    try:
+        r = production_drill.run_smoke(tmp, verbose=False)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "device_kind": jax.devices()[0].device_kind,
+        "load_kind": r["load_kind"],
+        "baseline_kind": r["baseline_kind"],
+        "chaos_fingerprint": r["chaos"]["fingerprint"],
+        "requests": r["traffic"]["requests"],
+        "rows": r["traffic"]["rows"],
+        "hot_swaps": r["request_loss"]["hot_swaps"],
+        "requests_failed": r["request_loss"]["failed"],
+        "publish_crash_fired": r["chaos"]["publish_crash_fired"],
+        "staleness_p50_s": r["staleness"]["staleness_p50_s"],
+        "staleness_p95_s": r["staleness"]["staleness_p95_s"],
+        "staleness_uncovered_rows": r["staleness"]["uncovered_rows"],
+        "serving_p50_ms": (round(r["serving"]["serving_p50_ms"], 3)
+                           if r["serving"]["serving_p50_ms"] is not None
+                           else None),
+        "serving_p99_ms": (round(r["serving"]["serving_p99_ms"], 3)
+                           if r["serving"]["serving_p99_ms"] is not None
+                           else None),
+        "skew_mismatches": r["skew"]["mismatches"],
+        "windowed_auc": r["windowed_auc"],
+        "drill_elapsed_s": r["elapsed_s"],
+    }
+
+
 def cascade_series() -> dict:
     """Retrieval→ranking cascade: end-to-end ``recommend()`` latency (user
     tower -> candidate index -> packed ranking batch -> top-k) p50/p99 and
@@ -948,6 +1003,12 @@ def main() -> None:
         print(f"bench: cascade series error: {e}", file=sys.stderr)
         cascade = {"error": str(e)}
 
+    try:
+        production_day = production_day_series()
+    except Exception as e:
+        print(f"bench: production-day series error: {e}", file=sys.stderr)
+        production_day = {"error": str(e)}
+
     nominal_per_accel_baseline = 250_000.0 / 4.0
     # MFU from the device-only series (no transfer in the window): model
     # FLOPs/example x device-only examples/sec/chip over the device peak.
@@ -988,6 +1049,7 @@ def main() -> None:
         "serving": serving,
         "multitask": multitask,
         "cascade": cascade,
+        "production_day": production_day,
         "pallas_smoke": pallas_smoke,
     }
     if scaling is not None:

@@ -1,0 +1,49 @@
+"""Closed-loop feedback layer: serve -> log -> delayed labels -> train shards.
+
+The reference's production loop (impression logging, label attribution,
+periodic retrains) lived outside the repo, in the ad platform; here it is an
+owned subsystem so the whole serve->log->train->publish cycle can be drilled
+as one system (scripts/production_drill.py):
+
+  * :class:`~deepfm_tpu.loop.impressions.ImpressionLogger` — served requests
+    written back as TFRecord shards via atomic rename (the same
+    write-then-``os.replace`` contract the online stream source expects of
+    any producer).
+  * :class:`~deepfm_tpu.loop.join.DelayedLabelJoiner` — impressions joined
+    with labels arriving on a delay distribution, emitted as training shards
+    bit-identical in schema to ``generate_synthetic_ctr`` output; duplicate
+    impressions, late labels, and labels past the join window are counted,
+    never silently dropped (:class:`~deepfm_tpu.loop.health.LoopHealth`).
+  * :class:`~deepfm_tpu.loop.skew.SkewChecker` — the training decoder and
+    the serving feature path must produce bit-identical features for the
+    same logged record (training/serving skew is the classic silent killer
+    of online CTR systems).
+  * :class:`~deepfm_tpu.loop.traffic.DiurnalTrafficPlan` — a seeded,
+    precomputed diurnal request plan with hidden-model ground-truth labels,
+    so two drills with the same seed replay identical traffic.
+  * :mod:`~deepfm_tpu.loop.metrics` — windowed online-vs-frozen AUC and
+    staleness percentiles for the drill's metrics plane.
+
+Everything here is numpy + the pure-Python codec: no jax import, so the
+feedback layer can run in light processes (loggers, joiners) that never
+touch a device.
+"""
+
+from .health import LoopHealth
+from .impressions import ImpressionLogger, iter_impressions
+from .join import DelayedLabelJoiner, SeededLabelFeed
+from .metrics import staleness_summary, windowed_auc
+from .skew import SkewChecker
+from .traffic import DiurnalTrafficPlan
+
+__all__ = [
+    "DelayedLabelJoiner",
+    "DiurnalTrafficPlan",
+    "ImpressionLogger",
+    "LoopHealth",
+    "SeededLabelFeed",
+    "SkewChecker",
+    "iter_impressions",
+    "staleness_summary",
+    "windowed_auc",
+]

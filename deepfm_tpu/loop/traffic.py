@@ -1,0 +1,93 @@
+"""Seeded diurnal traffic: a precomputed, bit-replayable request plan.
+
+Production request rates are day-shaped; a drill that only ever sees a flat
+rate never exercises the batcher's two regimes (deadline-bound at the
+trough, max-batch-bound at the peak). The plan compresses one "day" into
+``duration_s``: request arrivals follow a nonhomogeneous Poisson process
+with rate ``base_qps + (peak_qps - base_qps) * sin^2(pi * t / duration)``
+(trough at both ends, peak mid-run — the chaos schedule's 20-80% event
+window lands its faults on the peak).
+
+Everything — arrival times, request sizes, feature arrays, ground-truth
+labels — is drawn up front from one seed, so two plans with equal seeds are
+element-for-element identical and a drill replay serves byte-identical
+traffic. Labels follow the same hidden-logistic model as
+``libsvm.generate_synthetic_ctr`` (``hidden_seed`` fixes the ground truth
+independently of the traffic seed), drawn per-impression from a
+``(seed, impression_id)``-keyed rng — deterministic even if requests are
+served out of order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class PlannedRequest:
+    t_s: float            # scheduled submit time, seconds from plan start
+    first_id: int         # impression id of row 0 (rows are consecutive)
+    ids: np.ndarray       # [n, F] int32 — exactly what serving scores
+    vals: np.ndarray      # [n, F] float32
+    labels: np.ndarray    # [n] float32 ground truth (known to the drill,
+    #                       revealed to the joiner only after the delay)
+
+
+class DiurnalTrafficPlan:
+    """Precomputed request schedule + hidden-model ground truth."""
+
+    def __init__(self, seed: int, *, duration_s: float, base_qps: float,
+                 peak_qps: float, feature_size: int, field_size: int,
+                 max_rows: int = 8, hidden_seed: int = 12345):
+        if peak_qps < base_qps or base_qps <= 0:
+            raise ValueError(
+                f"need 0 < base_qps <= peak_qps, got {base_qps}/{peak_qps}")
+        self.seed = int(seed)
+        self.duration_s = float(duration_s)
+        self.hidden_w = np.random.default_rng(hidden_seed).normal(
+            0, 1.0, size=feature_size).astype(np.float32)
+        rng = np.random.default_rng(self.seed)
+        requests: List[PlannedRequest] = []
+        t, next_id = 0.0, 0
+        while True:
+            # Thinning (Lewis-Shedler): candidate arrivals at the peak
+            # rate, accepted with probability rate(t)/peak — exact for a
+            # nonhomogeneous Poisson process, and fully seeded.
+            t += float(rng.exponential(1.0 / peak_qps))
+            if t >= self.duration_s:
+                break
+            rate = base_qps + (peak_qps - base_qps) * (
+                math.sin(math.pi * t / self.duration_s) ** 2)
+            if float(rng.random()) >= rate / peak_qps:
+                continue
+            n = int(rng.integers(1, max_rows + 1))
+            ids = rng.integers(0, feature_size,
+                               (n, field_size)).astype(np.int32)
+            vals = rng.normal(size=(n, field_size)).astype(np.float32)
+            labels = np.empty((n,), np.float32)
+            for r in range(n):
+                labels[r] = self._draw_label(next_id + r, ids[r], vals[r])
+            requests.append(PlannedRequest(
+                t_s=round(t, 6), first_id=next_id,
+                ids=ids, vals=vals, labels=labels))
+            next_id += n
+        self.requests: Tuple[PlannedRequest, ...] = tuple(requests)
+        self.total_rows = next_id
+
+    def _draw_label(self, impression_id: int, ids: np.ndarray,
+                    vals: np.ndarray) -> float:
+        logit = float(np.dot(self.hidden_w[ids], vals)) * 0.5
+        p = 1.0 / (1.0 + math.exp(-logit))
+        u = np.random.default_rng(
+            (self.seed + 1) * 2_654_435_761 + int(impression_id)).random()
+        return float(u < p)
+
+    def fingerprint_data(self) -> Tuple:
+        """Deterministic digestable view (times, ids, labels) for audit
+        fingerprints."""
+        return tuple((r.t_s, r.first_id, int(r.ids.shape[0]),
+                      r.labels.tobytes()) for r in self.requests)

@@ -171,6 +171,8 @@ class ServingEngine:
         wkw = dict(watcher_kw or {})
         wkw.setdefault("loader", lambda path: export_lib.load_serving(
             path, buckets=resolved))
+        wkw.setdefault("on_error",
+                       lambda exc: stats.record_watcher_error())
         watcher = export_lib.watch_latest(
             publish_dir, poll_secs=poll_secs,
             on_swap=lambda path: stats.record_swap(),

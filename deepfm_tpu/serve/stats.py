@@ -50,6 +50,7 @@ class ServingStats:
         self.real_rows = 0            # sum of real rows over flushes
         self.max_batch_flushes = 0    # flushes that filled max_batch rows
         self.deadline_flushes = 0     # flushes fired by the delay deadline
+        self.watcher_errors = 0       # LatestWatcher poll-loop exceptions
         self.latencies_ms: List[float] = []
         self.swap_blackouts_ms: List[float] = []
         self._first_done: Optional[float] = None
@@ -92,6 +93,12 @@ class ServingStats:
                 self._swap_at = None
             self._last_done = now
 
+    def record_watcher_error(self) -> None:
+        """The LATEST poll loop hit an unexpected exception (and kept the
+        current model). Alive-but-failing watchers must be visible."""
+        with self._lock:
+            self.watcher_errors += 1
+
     def record_swap(self) -> None:
         """A hot model swap happened; the next flush closes the blackout
         window (time the response stream went without a completion)."""
@@ -125,6 +132,7 @@ class ServingStats:
                     if self.flushes else None),
                 "serving_max_batch_flushes": self.max_batch_flushes,
                 "serving_deadline_flushes": self.deadline_flushes,
+                "serving_watcher_errors": self.watcher_errors,
                 "swap_blackout_ms": (
                     round(max(self.swap_blackouts_ms), 3)
                     if self.swap_blackouts_ms else None),

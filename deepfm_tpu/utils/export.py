@@ -17,6 +17,7 @@ round-trip analog used by tests and the infer benchmark.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
@@ -203,6 +204,12 @@ def _export_tf_savedmodel(serve: Callable, params, model_state, cfg: Config,
     proto limit at CTR scale. Lowering/trace failures degrade with a
     warning; ``tf.saved_model.save`` I/O failures propagate.
     """
+    if os.environ.get("DEEPFM_TPU_SKIP_TF_EXPORT", ""):
+        # Drill/test seam (docs/TUNING.md seam table): the TF SavedModel
+        # sidecar costs ~10s per publish and the jax-native serving runtime
+        # never reads it — subprocess drills set this to keep the publish
+        # cadence realistic. Production publishes leave it unset.
+        return
     try:
         import tensorflow as tf  # noqa: PLC0415 (lazy, heavy)
         from jax.experimental import jax2tf  # noqa: PLC0415
@@ -446,6 +453,7 @@ class LatestWatcher:
 
     def __init__(self, publish_dir: str, *, poll_secs: float = 2.0,
                  on_swap: Optional[Callable[[str], None]] = None,
+                 on_error: Optional[Callable[[BaseException], None]] = None,
                  loader: Callable[[str], Callable] = load_serving,
                  start: bool = True,
                  prewarm: bool = True,
@@ -453,6 +461,7 @@ class LatestWatcher:
         self._publish_dir = publish_dir
         self._poll_secs = float(poll_secs)
         self._on_swap = on_swap
+        self._on_error = on_error
         self._loader = loader
         self._prewarm = bool(prewarm)
         self._stop = threading.Event()
@@ -469,6 +478,14 @@ class LatestWatcher:
         # just a warning — a serving drill asserting "zero dropped requests
         # across N swaps" also wants to know how many swaps never happened.
         self.swap_failures = 0
+        # Unexpected poll-loop exceptions (loader bugs, filesystem faults
+        # outside the anticipated ArtifactIncomplete/OSError/ValueError
+        # classes). The poll thread NEVER dies on these — it keeps serving
+        # the current model and retries — but dying silently and counting
+        # are different things: this is the counter, surfaced through
+        # ``ServingStats`` so a drill (or production alerting) can see a
+        # watcher that is alive but failing.
+        self.watcher_errors = 0
         self._thread: Optional[threading.Thread] = None
         self.check_once()
         if start:
@@ -522,8 +539,13 @@ class LatestWatcher:
             try:
                 self.check_once()
             except Exception as e:  # never kill the serving thread
-                self.swap_failures += 1
+                self.watcher_errors += 1
                 ulog.warning(f"LATEST poll failed ({e}); retrying")
+                if self._on_error is not None:
+                    try:
+                        self._on_error(e)
+                    except Exception:
+                        pass
 
     def __call__(self, feat_ids: np.ndarray,
                  feat_vals: np.ndarray) -> np.ndarray:

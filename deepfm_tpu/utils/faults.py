@@ -16,9 +16,13 @@ sleeps here — pair with a zero-delay ``RetryPolicy`` for millisecond tests.
 
 from __future__ import annotations
 
+import dataclasses
+import hashlib
 import io
+import json
+import random
 import threading
-from typing import Dict, Iterable, Optional, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 from ..data import fileio
 
@@ -148,22 +152,33 @@ def check_cold_fetch() -> None:
     raise InjectedFault("injected cold-store fetch failure")
 
 
-# Env seam for subprocess drills (scripts/online_drill.py): the train task
-# calls install_env_faults() at startup; with DEEPFM_TPU_READ_FAULT_EVERY=k
-# set, a process-wide FlakyFS making every k-th read fail once is installed,
-# so a *launched* online job heals scripted transient faults — the in-process
-# context-manager pattern can't reach a subprocess.
+# Env seams for subprocess drills (scripts/online_drill.py,
+# scripts/production_drill.py): the train task calls install_env_faults()
+# at startup. Two ways in, one mechanism (docs/TUNING.md has the full seam
+# table):
+#
+#   * DEEPFM_TPU_READ_FAULT_EVERY=k — the original single-knob var; still
+#     honored (it becomes a read_faults event of the schedule below).
+#   * DEEPFM_TPU_CHAOS_SCHEDULE=<json|@path> — a serialized ChaosSchedule;
+#     every process-local kind (read faults, publish crash, cold-fetch
+#     failures, NaN batches, step-indexed preempt/fault triggers) is armed
+#     from the one seeded plan, so a drill configures ALL its chaos through
+#     a single bit-exactly replayable object instead of N ad-hoc env vars.
 READ_FAULT_ENV = "DEEPFM_TPU_READ_FAULT_EVERY"
+CHAOS_ENV = "DEEPFM_TPU_CHAOS_SCHEDULE"
+# One-shot arming guard across supervised restarts: a JSON file recording
+# which schedule events were already armed in a previous incarnation of the
+# process (publish crashes and NaN plans must fire once per drill, not once
+# per restart). Unset = re-arm on every process start.
+CHAOS_STATE_ENV = "DEEPFM_TPU_CHAOS_STATE"
 
 
 def install_env_faults() -> Optional["FlakyFS"]:
     import os
-    every = int(os.environ.get(READ_FAULT_ENV, "0") or 0)
-    if every <= 0:
+    schedule = ChaosSchedule.from_env(os.environ)
+    if schedule is None:
         return None
-    fs = FlakyFS(read_fail_every=every)
-    fileio.set_fault_injector(fs)
-    return fs
+    return schedule.install(state_path=os.environ.get(CHAOS_STATE_ENV) or None)
 
 
 class FlakyStream(io.RawIOBase):
@@ -323,3 +338,227 @@ class FlakyFS:
             cls, original = self._ckpt_patch
             cls._do_save = original
             self._ckpt_patch = None
+
+
+# -- chaos schedule ------------------------------------------------------
+#
+# The seams above grew one drill at a time: FlakyFS (fault drill),
+# set_publish_crash (publish atomicity tests), set_cold_fetch_plan
+# (hot/cold tiering), set_nan_plan (guard tests), and the step-indexed
+# DEEPFM_TPU_PREEMPT_* env triggers (preemption drill). Each is armed by a
+# different call at a different place, so a whole-system drill had no way
+# to say "this exact storm, reproducibly". ChaosSchedule is that one plan:
+# a seeded, time-indexed event list that serializes to JSON (bit-exact:
+# same seed + params -> byte-identical JSON -> same fingerprint), crosses
+# process boundaries via one env var, and arms every existing seam without
+# changing any of them.
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosEvent:
+    """One scheduled fault: ``kind`` at ``at_s`` seconds from drill start.
+
+    ``at_s`` is advisory for process-local kinds (they are armed at
+    process start and fire at their seam's natural trigger point); it is
+    the actual firing time for driver-side kinds (``preempt``), which the
+    drill process executes against its own clock.
+    """
+
+    at_s: float
+    kind: str
+    arg: Tuple[Tuple[str, Any], ...] = ()
+
+    def get(self, key: str, default: Any = None) -> Any:
+        for k, v in self.arg:
+            if k == key:
+                return v
+        return default
+
+    @staticmethod
+    def make(at_s: float, kind: str, **arg: Any) -> "ChaosEvent":
+        return ChaosEvent(round(float(at_s), 3), str(kind),
+                          tuple(sorted(arg.items())))
+
+
+class ChaosSchedule:
+    """Seeded, time-indexed fault plan unifying every injection seam.
+
+    * ``generate(seed, ...)`` draws event times from ``random.Random(seed)``
+      — a pure function of its arguments, so the same call reproduces the
+      identical plan (``fingerprint()`` pins that).
+    * ``to_json()``/``from_json()`` round-trip the plan canonically
+      (sorted keys, fixed float rounding) for logs and env transport.
+    * ``install()`` arms every PROCESS_KIND through the existing seams:
+      read faults -> a FlakyFS fileio injector; ``publish_crash`` ->
+      :func:`set_publish_crash`; ``cold_fetch`` ->
+      :func:`set_cold_fetch_plan`; ``nan_batches`` -> :func:`set_nan_plan`;
+      ``preempt_after_steps``/``fault_after_steps``/``hold_after_steps`` ->
+      the ``DEEPFM_TPU_*`` step-trigger env vars the train task reads
+      AFTER :func:`install_env_faults` runs. One-shot kinds are guarded by
+      ``state_path`` so a supervised restart does not re-arm them.
+    * DRIVER_KINDS (``preempt``: send SIGTERM at ``at_s``) are executed by
+      the drill process itself via :meth:`due` — a subprocess cannot
+      SIGTERM itself usefully from an env var.
+    """
+
+    PROCESS_KINDS = ("read_faults", "publish_crash", "cold_fetch",
+                     "nan_batches", "preempt_after_steps",
+                     "fault_after_steps", "hold_after_steps")
+    DRIVER_KINDS = ("preempt",)
+    #: kinds that must fire once per drill, not once per process start
+    ONESHOT_KINDS = ("publish_crash", "cold_fetch", "nan_batches")
+    KINDS = PROCESS_KINDS + DRIVER_KINDS
+
+    def __init__(self, events: Iterable[ChaosEvent], *,
+                 seed: Optional[int] = None):
+        events = tuple(sorted(events, key=lambda e: e.at_s))
+        for ev in events:
+            if ev.kind not in self.KINDS:
+                raise ValueError(
+                    f"unknown chaos kind {ev.kind!r} (know {self.KINDS})")
+        self.events = events
+        self.seed = seed
+
+    @classmethod
+    def generate(cls, seed: int, *, horizon_s: float,
+                 read_fault_every: int = 0,
+                 publish_crashes: int = 0,
+                 publish_crash_stage: str = "before_rename",
+                 preemptions: int = 0,
+                 cold_fetch_fails: int = 0,
+                 nan_batches: int = 0) -> "ChaosSchedule":
+        """Draw a plan for a drill of ``horizon_s`` seconds. Event times
+        land in the middle 20-80% of the horizon (chaos during steady
+        state, not during come-up or drain). stdlib ``random`` on purpose:
+        its sequence is pinned by the language spec, so the plan is stable
+        across library versions."""
+        rng = random.Random(int(seed))
+        events: List[ChaosEvent] = []
+        if read_fault_every > 0:
+            events.append(ChaosEvent.make(
+                0.0, "read_faults", every=int(read_fault_every)))
+        for _ in range(int(publish_crashes)):
+            events.append(ChaosEvent.make(
+                rng.uniform(0.2, 0.8) * horizon_s, "publish_crash",
+                stage=str(publish_crash_stage)))
+        for _ in range(int(preemptions)):
+            events.append(ChaosEvent.make(
+                rng.uniform(0.2, 0.8) * horizon_s, "preempt"))
+        if cold_fetch_fails > 0:
+            events.append(ChaosEvent.make(
+                0.0, "cold_fetch", fails=int(cold_fetch_fails)))
+        if nan_batches > 0:
+            batches = sorted(rng.sample(range(2, 50), int(nan_batches)))
+            events.append(ChaosEvent.make(
+                0.0, "nan_batches", batches=tuple(batches)))
+        return cls(events, seed=int(seed))
+
+    # -- serialization --------------------------------------------------
+    def to_json(self) -> str:
+        return json.dumps(
+            {"seed": self.seed,
+             "events": [{"at_s": ev.at_s, "kind": ev.kind,
+                         "arg": dict(ev.arg)} for ev in self.events]},
+            sort_keys=True, separators=(",", ":"))
+
+    @classmethod
+    def from_json(cls, text: str) -> "ChaosSchedule":
+        spec = json.loads(text)
+        events = []
+        for ev in spec["events"]:
+            arg = {k: (tuple(v) if isinstance(v, list) else v)
+                   for k, v in ev.get("arg", {}).items()}
+            events.append(ChaosEvent.make(ev["at_s"], ev["kind"], **arg))
+        return cls(events, seed=spec.get("seed"))
+
+    @classmethod
+    def from_env(cls, environ) -> Optional["ChaosSchedule"]:
+        """The one entry point for env-carried chaos: merges the serialized
+        schedule (CHAOS_ENV, inline JSON or ``@/path``) with the legacy
+        READ_FAULT_ENV knob — the old var keeps working by BECOMING a
+        ``read_faults`` event (schedule wins if both specify read faults).
+        None when neither var asks for anything."""
+        schedule = None
+        spec = environ.get(CHAOS_ENV, "")
+        if spec:
+            if spec.startswith("@"):
+                with open(spec[1:], encoding="utf-8") as f:
+                    spec = f.read()
+            schedule = cls.from_json(spec)
+        every = int(environ.get(READ_FAULT_ENV, "0") or 0)
+        if every > 0 and (schedule is None
+                          or not schedule.events_of("read_faults")):
+            events = schedule.events if schedule is not None else ()
+            seed = schedule.seed if schedule is not None else None
+            schedule = cls(
+                events + (ChaosEvent.make(0.0, "read_faults", every=every),),
+                seed=seed)
+        return schedule
+
+    def fingerprint(self) -> str:
+        """Stable hex id of the exact plan (stamped into drill reports; two
+        runs with equal fingerprints replayed the identical chaos)."""
+        return hashlib.sha256(self.to_json().encode()).hexdigest()[:16]
+
+    # -- queries --------------------------------------------------------
+    def events_of(self, *kinds: str) -> Tuple[ChaosEvent, ...]:
+        return tuple(ev for ev in self.events if ev.kind in kinds)
+
+    def due(self, now_s: float, fired: set) -> List[ChaosEvent]:
+        """Driver-side pump: DRIVER_KINDS events scheduled at or before
+        ``now_s`` not yet in ``fired`` (which this call updates)."""
+        out = []
+        for i, ev in enumerate(self.events):
+            if ev.kind in self.DRIVER_KINDS and i not in fired \
+                    and ev.at_s <= now_s:
+                fired.add(i)
+                out.append(ev)
+        return out
+
+    # -- process-local arming -------------------------------------------
+    def install(self, state_path: Optional[str] = None) -> Optional[FlakyFS]:
+        """Arm every process-local kind through its existing seam.
+
+        Continuous kinds (read faults, step triggers) re-arm on every call
+        — a restarted process lives in the same weather. ONESHOT_KINDS arm
+        at most once per ``state_path`` (atomically updated JSON list of
+        armed event keys), so one scheduled publish crash fires once per
+        drill even across supervised restarts."""
+        import os
+        armed: List[str] = []
+        if state_path and os.path.exists(state_path):
+            with open(state_path, encoding="utf-8") as f:
+                armed = json.load(f)
+        newly: List[str] = []
+        fs: Optional[FlakyFS] = None
+        for i, ev in enumerate(self.events):
+            key = f"{i}:{ev.kind}"
+            if ev.kind in self.ONESHOT_KINDS and key in armed:
+                continue
+            if ev.kind == "read_faults":
+                fs = FlakyFS(read_fail_every=int(ev.get("every", 0)))
+                fileio.set_fault_injector(fs)
+            elif ev.kind == "publish_crash":
+                set_publish_crash(ev.get("stage", "before_rename"))
+                newly.append(key)
+            elif ev.kind == "cold_fetch":
+                set_cold_fetch_plan(int(ev.get("fails", 0)))
+                newly.append(key)
+            elif ev.kind == "nan_batches":
+                set_nan_plan(ev.get("batches", ()))
+                newly.append(key)
+            elif ev.kind == "preempt_after_steps":
+                os.environ["DEEPFM_TPU_PREEMPT_AFTER_STEPS"] = str(
+                    int(ev.get("steps", 0)))
+            elif ev.kind == "fault_after_steps":
+                os.environ["DEEPFM_TPU_FAULT_AFTER_STEPS"] = str(
+                    int(ev.get("steps", 0)))
+            elif ev.kind == "hold_after_steps":
+                os.environ["DEEPFM_TPU_PREEMPT_HOLD_AFTER_STEPS"] = str(
+                    int(ev.get("steps", 0)))
+        if newly and state_path:
+            tmp = state_path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(armed + newly, f)
+            os.replace(tmp, state_path)
+        return fs

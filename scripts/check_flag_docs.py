@@ -28,9 +28,14 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DOC = os.path.join(REPO, "docs", "MIGRATION.md")
 
 #: ``--tokens`` in MIGRATION.md that are deliberately not Config fields:
-#: the generic ``--flag value`` syntax placeholder and the standalone
-#: converter tool's own CLI (``tools/libsvm_to_tfrecord.py``).
-NON_CONFIG_TOKENS = frozenset({"flag", "input", "output", "shards"})
+#: the generic ``--flag value`` syntax placeholder, the standalone
+#: converter tool's own CLI (``tools/libsvm_to_tfrecord.py``), and the
+#: script-local CLIs of ``scripts/production_drill.py`` /
+#: ``scripts/supervise.py`` (drill and supervisor knobs, not train flags).
+NON_CONFIG_TOKENS = frozenset({
+    "flag", "input", "output", "shards",
+    "smoke", "pace", "healthy_secs", "max_total_restarts",
+})
 
 
 def _doc(doc_text):

@@ -1,0 +1,757 @@
+#!/usr/bin/env python
+"""Production-day drill: chaos-scheduled serve->log->train->publish loop.
+
+The whole online system exercised as ONE closed loop, the way a production
+day actually runs it — and failed the way a production day actually fails:
+
+  1. **Serve.** A seeded diurnal traffic plan (``loop/traffic.py``) drives
+     the real ``ServingEngine.serve_latest`` over the publish dir, starting
+     from a bootstrap version-0 artifact (which doubles as the frozen
+     baseline model for the windowed-AUC comparison).
+  2. **Log.** Every served request is written back as impression shards
+     (``loop/impressions.py``), bit-identical to what the engine scored.
+  3. **Join.** Ground-truth labels arrive on a seeded delay distribution;
+     the delayed-label joiner (``loop/join.py``) emits training shards into
+     the live stream directory — duplicates, late labels, and past-window
+     labels counted, emission exactly-once and in admission order.
+  4. **Train + publish.** The real online trainer (``deepfm_tpu.launch``
+     with ``--online_mode`` under ``scripts/supervise.py``) tails those
+     shards and hot-publishes through the production ``Publisher``; the
+     serving engine hot-swaps every version with zero dropped requests.
+  5. **Chaos.** One seeded :class:`~deepfm_tpu.utils.faults.ChaosSchedule`
+     arms everything: transient read faults inside the trainer's stream,
+     one publish crash mid-``os.replace`` sequence (previous artifact stays
+     live), and one driver-side SIGTERM preemption with supervised resume.
+     Same seed + schedule => byte-identical chaos, traffic, and labels.
+
+Gates (the PRODUCTION_r0N.json contract):
+  * zero dropped/failed/overloaded requests across >= 3 hot swaps;
+  * training/serving skew: every audited record bit-identical between the
+    serving feature path and the training decoder;
+  * end-to-end staleness (impression -> first servable model trained on
+    it) p95 reported and bounded by join window + label delay + observed
+    publish cadence;
+  * final online params finite, publish versions monotonic, LATEST = max;
+  * the joiner's audit (counters + joined labels) matches a pure logical
+    simulation computed from the seeds alone — the executable form of
+    "same seed + schedule reproduces identical drill audit results".
+
+Run on CPU:  JAX_PLATFORMS=cpu python scripts/production_drill.py
+Fast in-process smoke (no subprocess): ``run_smoke()`` (tier-1 tested).
+"""
+
+import argparse
+import collections
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import orbax.checkpoint as ocp
+
+from deepfm_tpu.config import Config
+from deepfm_tpu.loop import (DelayedLabelJoiner, DiurnalTrafficPlan,
+                             ImpressionLogger, LoopHealth, SeededLabelFeed,
+                             SkewChecker, staleness_summary, windowed_auc)
+from deepfm_tpu.serve import ServingEngine
+from deepfm_tpu.train import Trainer
+from deepfm_tpu.train.publish import Publisher
+from deepfm_tpu.utils import export as export_lib
+from deepfm_tpu.utils import faults as faults_lib
+
+from supervise import run_supervised
+
+FEATURE_SIZE = 64
+FIELD_SIZE = 5
+BATCH_SIZE = 16
+SHARD_RECORDS = 32       # impression rows per logged shard
+
+# Full drill (subprocess trainer, the committed PRODUCTION report).
+FULL = dict(duration_s=24.0, base_qps=6.0, peak_qps=22.0, max_rows=6,
+            publish_every=6, join_window_s=4.0, delay_s=(0.5, 6.0),
+            read_fault_every=11, idle_timeout_s=10.0, auc_windows=4)
+# In-process smoke (tier-1): same loop, mini-trainer thread, pace-compressed.
+SMOKE = dict(duration_s=8.0, base_qps=8.0, peak_qps=30.0, max_rows=4,
+             publish_every=4, join_window_s=3.0, delay_s=(0.3, 4.5),
+             read_fault_every=0, idle_timeout_s=0.0, auc_windows=3)
+
+MIN_HOT_SWAPS = 3
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _say_factory(verbose):
+    return (lambda msg: print(f"[production_drill] {msg}", flush=True)) \
+        if verbose else (lambda msg: None)
+
+
+def _flags(data_dir, model_dir, publish_every, idle_timeout_s):
+    return dict(
+        task_type="train", data_dir=data_dir, model_dir=model_dir,
+        feature_size=FEATURE_SIZE, field_size=FIELD_SIZE, embedding_size=4,
+        deep_layers="8", dropout="1.0", batch_size=BATCH_SIZE, num_epochs=1,
+        compute_dtype="float32", mesh_data=1, log_steps=0,
+        scale_lr_by_world=False, seed=17, verify_crc=True,
+        save_checkpoints_steps=0, io_retry_backoff_secs=0.0,
+        pipe_mode=1, online_mode=1, steps_per_loop=1,
+        publish_every_steps=publish_every,
+        stream_poll_secs=0.1, stream_idle_timeout_secs=idle_timeout_s,
+        serve_max_batch=64, serve_max_delay_ms=3.0)
+
+
+def _cmd(flags):
+    argv = [sys.executable, "-m", "deepfm_tpu.launch"]
+    for name, value in flags.items():
+        argv += [f"--{name}", str(int(value) if isinstance(value, bool)
+                                  else value)]
+    return argv
+
+
+def _env(**extra):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=_REPO_ROOT + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    for k in ("DEEPFM_TPU_PREEMPT_HOLD_AFTER_STEPS",
+              "DEEPFM_TPU_PREEMPT_AFTER_STEPS",
+              "DEEPFM_TPU_FAULT_AFTER_STEPS",
+              faults_lib.READ_FAULT_ENV, faults_lib.CHAOS_ENV,
+              faults_lib.CHAOS_STATE_ENV):
+        env.pop(k, None)
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+class _LogicalClock:
+    """Wall time -> drill-logical time: ``pace`` wall seconds per logical
+    second. Every join/label/chaos decision is made in logical time, so
+    the smoke (pace << 1) and the full drill replay the SAME decisions."""
+
+    def __init__(self, pace):
+        self.pace = float(pace)
+        self._t0 = time.monotonic()
+
+    def now(self):
+        return (time.monotonic() - self._t0) / self.pace
+
+
+def _bootstrap_v0(cfg, publish_dir, say):
+    """Publish the version-0 artifact the engine serves before the trainer
+    has produced anything; its params are the frozen AUC baseline."""
+    trainer = Trainer(cfg)
+    state = trainer.init_state()
+    pub = Publisher(trainer.model, cfg, publish_dir)
+    pub.publish_now(state, 0)
+    pub.close()
+    path = os.path.join(publish_dir, "0")
+    assert os.path.exists(os.path.join(path, export_lib.COMPLETE_MARKER)), \
+        "bootstrap publish did not complete"
+    say(f"bootstrap artifact v0 live at {path}")
+    return export_lib.load_serving(path)
+
+
+def _expected_join(plan, feed, join_window_s):
+    """Pure logical simulation of every join decision from the seeds alone
+    — what the live joiner MUST reproduce bit-exactly."""
+    counters = {"labels_joined": 0, "impressions_expired": 0,
+                "labels_past_window": 0}
+    labels = {}
+    for req in plan.requests:
+        for k in range(int(req.ids.shape[0])):
+            iid = req.first_id + k
+            if feed.delay_for(iid) <= join_window_s:
+                counters["labels_joined"] += 1
+                labels[iid] = float(req.labels[k])
+            else:
+                counters["impressions_expired"] += 1
+                counters["labels_past_window"] += 1
+                labels[iid] = DelayedLabelJoiner.DEFAULT_LABEL
+    return counters, labels
+
+
+def _emitted_labels(out_dir):
+    """iid -> label actually emitted, read back from the manifest sidecars."""
+    out = {}
+    for name in sorted(os.listdir(out_dir)):
+        if not (name.startswith(".") and name.endswith(".manifest.json")):
+            continue
+        with open(os.path.join(out_dir, name), encoding="utf-8") as f:
+            m = json.load(f)
+        out.update({int(i): float(y)
+                    for i, y in zip(m["impressions"], m["labels"])})
+    return out
+
+
+def _audit_artifacts(publish_dir, say):
+    """Every artifact loads and serves finite probs, marker step == dir
+    version, publish order monotonic, LATEST == max. Dot-prefixed staging
+    leftovers are counted, not fatal: a leaked ``.staging-*`` dir is the
+    EXPECTED evidence of the scheduled publish crash (the crash fires after
+    the staging dir is complete, before the rename)."""
+    versions, staging = {}, []
+    for name in os.listdir(publish_dir):
+        path = os.path.join(publish_dir, name)
+        if not os.path.isdir(path):
+            continue
+        if name.startswith("."):
+            staging.append(name)
+            continue
+        versions[int(name)] = path
+    assert versions, f"no artifacts under {publish_dir}"
+    for step, path in sorted(versions.items()):
+        serve = export_lib.load_serving(path)
+        probs = serve(np.zeros((2, FIELD_SIZE), np.int64),
+                      np.ones((2, FIELD_SIZE), np.float32))
+        assert probs.shape[0] == 2 and np.all(np.isfinite(probs)), (
+            f"artifact {path} served non-finite output")
+        with open(os.path.join(path, export_lib.COMPLETE_MARKER)) as f:
+            assert json.load(f)["step"] == step, (
+                f"artifact {path} marker step != dir version")
+    order = [s for s, _ in sorted(versions.items(),
+                                  key=lambda kv: os.path.getmtime(kv[1]))]
+    assert order == sorted(order), (
+        f"versions not monotonic in publish order: {order}")
+    latest = export_lib.read_latest(publish_dir)
+    assert latest is not None and int(os.path.basename(latest)) == max(
+        versions), f"LATEST resolves to {latest}, newest is {max(versions)}"
+    say(f"artifact audit: {len(versions)} version(s) "
+        f"{sorted(versions)}, {len(staging)} staging leak(s), "
+        f"LATEST={max(versions)}")
+    return versions, staging
+
+
+def _final_params_finite(publish_dir):
+    latest = export_lib.read_latest(publish_dir)
+    restored = ocp.StandardCheckpointer().restore(
+        os.path.join(os.path.abspath(latest), "params.ckpt"))
+    import jax
+    for leaf in jax.tree_util.tree_leaves(restored):
+        arr = np.asarray(leaf)
+        if np.issubdtype(arr.dtype, np.floating) \
+                and not np.all(np.isfinite(arr)):
+            return False
+    return True
+
+
+def _staleness_samples(joiner, served_wall, swap_log):
+    """Per-impression end-to-end staleness: serve completion -> the wall
+    moment the first model version whose training covered that impression
+    became servable (watcher swap observed). Rows past the last published
+    training step are 'uncovered' (awaiting the next cadence) — counted,
+    excluded from the percentile, reported."""
+    observed = sorted((v, w) for v, w, _ in swap_log if v > 0)
+    samples, uncovered = [], 0
+    cum = 0
+    for path, iids in sorted(joiner.manifests.items()):
+        cum += len(iids)
+        version = next((v for v, _ in observed
+                        if v * BATCH_SIZE >= cum), None)
+        if version is None:
+            uncovered += len(iids)
+            continue
+        wall = dict(observed)[version]
+        samples.extend(wall - served_wall[i] for i in iids)
+    return samples, uncovered
+
+
+def _audit_fingerprint(schedule, plan, counters, labels):
+    h = hashlib.sha256()
+    h.update(schedule.to_json().encode())
+    for r in plan.requests:
+        h.update(np.float64(r.t_s).tobytes())
+        h.update(np.int64(r.first_id).tobytes())
+        h.update(r.labels.tobytes())
+    h.update(json.dumps(sorted(counters.items())).encode())
+    h.update(json.dumps(sorted(labels.items())).encode())
+    return h.hexdigest()[:16]
+
+
+def _mini_trainer(cfg, data_dir, publish_dir, stop_evt, publish_every, out):
+    """In-process stand-in for the ``deepfm_tpu.launch`` subprocess (the
+    smoke variant): tail emitted tr-shards in sorted order, train real
+    steps, publish through the real ``Publisher`` on the step cadence —
+    synchronously, so the publish set is exactly {N, 2N, ...} and the
+    armed publish crash deterministically eats the first attempt."""
+    from deepfm_tpu.data import example_codec, tfrecord
+    try:
+        trainer = Trainer(cfg)
+        state = trainer.init_state()
+        step_fn = trainer._make_train_step()
+        pub = Publisher(trainer.model, cfg, publish_dir,
+                        every_steps=publish_every)
+        consumed = set()
+        rows_ids, rows_vals, rows_y = [], [], []
+        step = 0
+        while True:
+            names = [n for n in sorted(os.listdir(data_dir))
+                     if n.startswith("tr") and n.endswith(".tfrecords")
+                     and n not in consumed]
+            if not names:
+                if stop_evt.is_set():
+                    break
+                time.sleep(0.02)
+                continue
+            for name in names:
+                consumed.add(name)
+                for rec in tfrecord.iter_records(
+                        os.path.join(data_dir, name)):
+                    y, ids, vals = example_codec.decode_ctr_example(
+                        rec, FIELD_SIZE)
+                    rows_ids.append(ids.astype(np.int32))
+                    rows_vals.append(vals)
+                    rows_y.append(y)
+                while len(rows_y) >= cfg.batch_size:
+                    b = cfg.batch_size
+                    batch = {
+                        "label": np.asarray(
+                            rows_y[:b], np.float32).reshape(b, 1),
+                        "feat_ids": np.stack(rows_ids[:b]),
+                        "feat_vals": np.stack(rows_vals[:b]),
+                    }
+                    del rows_ids[:b], rows_vals[:b], rows_y[:b]
+                    state, _ = step_fn(state, trainer.put_batch(batch))
+                    step += 1
+                    if step % publish_every == 0:
+                        pub.publish_now(state, step)
+                        pub.drain()
+        pub.close()
+        out["steps"] = step
+        out["publish"] = pub.stats()
+        out["leftover_rows"] = len(rows_y)
+        out["rc"] = 0
+    except BaseException as e:  # noqa: BLE001 — surfaced by the drill
+        out["error"] = e
+        out["rc"] = 1
+
+
+def _subprocess_trainer(cmd, env, cell, done_evt, logs, out):
+    """The full-drill trainer: ``deepfm_tpu.launch`` under the real
+    supervisor. A clean (idle-timeout) exit while the drill is still
+    producing shards relaunches — the production pattern of an online
+    trainer that must outlive quiet stretches of its stream."""
+    def spawn(c):
+        p = subprocess.Popen(c, cwd=_REPO_ROOT, env=env)
+        cell["proc"] = p
+        rc = p.wait()
+        cell["proc"] = None
+        return rc
+
+    rcs = []
+    while True:
+        rc = run_supervised(cmd, max_restarts=10, backoff_secs=0.0,
+                            spawn=spawn, log=logs.append)
+        rcs.append(rc)
+        if rc != 0 or done_evt.is_set():
+            break
+        time.sleep(0.3)
+    out["rcs"] = rcs
+    out["rc"] = rcs[-1]
+
+
+def _run_core(workdir, *, mode, seed, pace, say):
+    params = FULL if mode == "full" else SMOKE
+    t_start = time.time()
+    os.makedirs(workdir, exist_ok=True)
+    imp_dir = os.path.join(workdir, "impressions")
+    data_dir = os.path.join(workdir, "data")
+    model_dir = os.path.join(workdir, "ckpt")
+    publish_dir = os.path.join(model_dir, "publish")
+    os.makedirs(data_dir, exist_ok=True)
+
+    schedule = faults_lib.ChaosSchedule.generate(
+        seed, horizon_s=params["duration_s"],
+        read_fault_every=params["read_fault_every"],
+        publish_crashes=1, publish_crash_stage="before_rename",
+        preemptions=1 if mode == "full" else 0)
+    say(f"chaos schedule {schedule.fingerprint()}: "
+        + ", ".join(f"{e.kind}@{e.at_s:g}s" for e in schedule.events))
+
+    plan = DiurnalTrafficPlan(
+        seed, duration_s=params["duration_s"], base_qps=params["base_qps"],
+        peak_qps=params["peak_qps"], feature_size=FEATURE_SIZE,
+        field_size=FIELD_SIZE, max_rows=params["max_rows"])
+    say(f"traffic plan: {len(plan.requests)} requests / "
+        f"{plan.total_rows} rows over {params['duration_s']:g} logical s "
+        f"(pace {pace:g})")
+    delay_lo, delay_hi = params["delay_s"]
+    feed = SeededLabelFeed(seed + 1, delay_min_s=delay_lo,
+                           delay_max_s=delay_hi)
+    health = LoopHealth()
+    logger = ImpressionLogger(imp_dir, shard_records=SHARD_RECORDS,
+                              health=health)
+    joiner = DelayedLabelJoiner(imp_dir, data_dir, feed,
+                                join_window_s=params["join_window_s"],
+                                health=health)
+
+    cfg = Config(**_flags(data_dir, model_dir, params["publish_every"],
+                          params["idle_timeout_s"]))
+    baseline_fn = _bootstrap_v0(cfg, publish_dir, say)
+
+    engine = ServingEngine.serve_latest(
+        publish_dir, poll_secs=0.05,
+        max_batch=cfg.serve_max_batch, max_delay_ms=cfg.serve_max_delay_ms)
+    watcher = engine.watcher
+
+    # ---- trainer side -------------------------------------------------
+    done_evt = threading.Event()
+    trainer_out, sup_logs, cell = {}, [], {"proc": None}
+    if mode == "full":
+        state_file = os.path.join(workdir, "chaos_state.json")
+        sched_file = os.path.join(workdir, "chaos_schedule.json")
+        with open(sched_file, "w", encoding="utf-8") as f:
+            f.write(schedule.to_json())
+        env = _env(DEEPFM_TPU_SKIP_TF_EXPORT=1,
+                   **{faults_lib.CHAOS_ENV: "@" + sched_file,
+                      faults_lib.CHAOS_STATE_ENV: state_file})
+        trainer_thread = threading.Thread(
+            target=_subprocess_trainer,
+            args=(_cmd(_flags(data_dir, model_dir, params["publish_every"],
+                              params["idle_timeout_s"])),
+                  env, cell, done_evt, sup_logs, trainer_out))
+    else:
+        schedule.install(
+            state_path=os.path.join(workdir, "chaos_state.json"))
+        trainer_thread = threading.Thread(
+            target=_mini_trainer,
+            args=(cfg, data_dir, publish_dir, done_evt,
+                  params["publish_every"], trainer_out))
+    trainer_thread.start()
+
+    # ---- the drill loop ----------------------------------------------
+    clock = _LogicalClock(pace)
+    served = {}           # iid -> (ids, vals) exactly as scored
+    served_wall = {}      # iid -> wall completion time
+    samples = []          # (t_s, label, online_prob, baseline_prob)
+    failures = []
+    swap_log = []         # (version, wall, logical) first-observed
+    fired, pending_preempts, preempts_sent = set(), [], []
+    seen_path = [None]
+    tail_ids = np.zeros((2, FIELD_SIZE), np.int32)
+    tail_vals = np.ones((2, FIELD_SIZE), np.float32)
+    last_tail = [0.0]
+    # Labels stay here until the row's impression shard is sealed on disk:
+    # a label polled before its impression is visible to the joiner is an
+    # orphan by contract (labels_late), and a half-filled logger shard is
+    # exactly that window. Deferring the PUSH never moves the ARRIVAL
+    # (served_at + delay_for(iid)), so join decisions stay seed-pure.
+    label_backlog = collections.deque()
+    logger_closed = [False]
+
+    def flush_labels():
+        sealed = (plan.total_rows if logger_closed[0]
+                  else SHARD_RECORDS * len(logger.shards))
+        while label_backlog and label_backlog[0][0] < sealed:
+            iid, y, t = label_backlog.popleft()
+            feed.push(iid, y, t)
+
+    def pump(now_l):
+        flush_labels()
+        for _ in joiner.pump(now_l):
+            pass
+        cur = watcher.current_path
+        if cur != seen_path[0]:
+            seen_path[0] = cur
+            try:
+                v = int(os.path.basename(cur))
+            except (TypeError, ValueError):
+                v = -1
+            swap_log.append((v, time.monotonic(), now_l))
+            say(f"hot swap -> v{v} at logical {now_l:.1f}s")
+        # Driver-side chaos: SIGTERM fires at its scheduled logical time,
+        # gated on the trainer having published once (the preempt handler
+        # is certainly installed by then; earlier, SIGTERM would hit the
+        # interpreter before the listener exists — a different failure
+        # than the one this drill schedules).
+        if any(v > 0 for v, _, _ in swap_log):
+            pending_preempts.extend(schedule.due(now_l, fired))
+        for ev in list(pending_preempts):
+            proc = cell.get("proc")
+            if proc is not None and proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+                pending_preempts.remove(ev)
+                preempts_sent.append(round(now_l, 2))
+                say(f"chaos: SIGTERM to trainer pid {proc.pid} "
+                    f"(scheduled {ev.at_s:g}s, fired {now_l:.1f}s)")
+
+    def tail_request():
+        # Keep requests flowing outside the plan (drain + trainer tail) so
+        # "zero loss across EVERY hot swap" covers the late swaps too.
+        if time.monotonic() - last_tail[0] < 0.08:
+            return
+        last_tail[0] = time.monotonic()
+        try:
+            engine.predict(tail_ids, tail_vals, timeout=60)
+        except Exception as e:  # noqa: BLE001 — the loss gate
+            failures.append(f"tail: {e!r}")
+
+    for req in plan.requests:
+        while clock.now() < req.t_s:
+            pump(clock.now())
+            time.sleep(min(0.005, max(0.0005, 0.002 * pace)))
+        try:
+            probs = engine.predict(req.ids, req.vals, timeout=60)
+        except Exception as e:  # noqa: BLE001 — the loss gate
+            failures.append(f"req@{req.t_s:g}: {e!r}")
+            continue
+        base = np.asarray(baseline_fn(req.ids, req.vals))
+        wall = time.monotonic()
+        iids = logger.log_request(req.first_id, req.ids, req.vals, req.t_s)
+        for k, iid in enumerate(iids):
+            served[iid] = (req.ids[k], req.vals[k])
+            served_wall[iid] = wall
+            label_backlog.append((iid, float(req.labels[k]), req.t_s))
+            samples.append((req.t_s, float(req.labels[k]),
+                            float(probs[k]), float(base[k])))
+    logger.close()
+    logger_closed[0] = True
+    say(f"traffic done: {len(served)} rows served+logged, "
+        f"{len(failures)} failures so far")
+
+    # Drain: pump until every label has arrived and every window closed,
+    # so the final counters are the pure function of the seeds (no
+    # finalize-forced expiries that a different pace would change).
+    while label_backlog or feed.pending or joiner.open_impressions:
+        pump(clock.now())
+        tail_request()
+        time.sleep(0.002)
+    joiner.finalize(clock.now())
+    done_evt.set()
+    say(f"label drain complete at logical {clock.now():.1f}s; "
+        f"health={json.dumps({k: v for k, v in health.snapshot().items() if v})}")
+
+    while trainer_thread.is_alive():
+        pump(clock.now())
+        tail_request()
+        time.sleep(0.01)
+    trainer_thread.join()
+    if trainer_out.get("error") is not None:
+        raise trainer_out["error"]
+    assert trainer_out.get("rc") == 0, (
+        f"trainer failed: {trainer_out}; supervisor log tail "
+        f"{sup_logs[-3:]}")
+
+    # Final servable state: LATEST must reach the max published version
+    # and the watcher must swap to it.
+    expected_max = max(int(n) for n in os.listdir(publish_dir)
+                       if os.path.isdir(os.path.join(publish_dir, n))
+                       and not n.startswith("."))
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        cur = watcher.current_path
+        if cur is not None and os.path.basename(cur) == str(expected_max):
+            break
+        pump(clock.now())
+        tail_request()
+        time.sleep(0.02)
+    pump(clock.now())
+
+    stats = engine.stats.summary()
+    swaps = watcher.swap_count
+    swap_failures = watcher.swap_failures
+    watcher_errors = watcher.watcher_errors
+    engine.close()
+
+    # ---- audits --------------------------------------------------------
+    counters = health.snapshot()
+    expected, expected_labels = _expected_join(
+        plan, feed, params["join_window_s"])
+    actual_labels = _emitted_labels(data_dir)
+    counters_ok = all(counters[k] == v for k, v in expected.items()) \
+        and counters["duplicate_impressions"] == 0 \
+        and counters["labels_late"] == 0 \
+        and counters["torn_impression_shards"] == 0 \
+        and counters["records_emitted"] == plan.total_rows
+    labels_ok = actual_labels == expected_labels
+    assert counters_ok, (
+        f"joiner counters diverged from the seed-pure simulation:\n"
+        f"  actual   {counters}\n  expected {expected}")
+    assert labels_ok, "emitted labels diverged from the simulation"
+    say("determinism: counters + emitted labels match the pure logical "
+        "simulation (seed-replayable)")
+
+    checker = SkewChecker(served)
+    for shard in joiner.emitted_shards:
+        checker.audit_shard(shard)
+    assert checker.ok, (
+        f"training/serving skew: {checker.mismatches[:5]}")
+    assert checker.records_audited == plan.total_rows, (
+        f"audited {checker.records_audited} of {plan.total_rows} rows")
+    say(f"skew check: {checker.records_audited} records bit-identical "
+        "across serving path and training decoder")
+
+    versions, staging = _audit_artifacts(publish_dir, say)
+    crashed_version = params["publish_every"]
+    crash_fired = crashed_version not in versions and len(staging) >= 1
+    finite = _final_params_finite(publish_dir)
+    assert finite, "final published params contain non-finite values"
+
+    stale_samples, uncovered = _staleness_samples(
+        joiner, served_wall, swap_log)
+    stale = staleness_summary(stale_samples)
+    pub_walls = sorted(w for v, w, _ in swap_log if v >= 0)
+    max_gap = max((b - a for a, b in zip(pub_walls, pub_walls[1:])),
+                  default=0.0)
+    stale_bound = (params["join_window_s"] + delay_hi) * pace \
+        + 2.0 * max_gap + 3.0
+
+    # ---- gates ---------------------------------------------------------
+    assert not failures, failures[:5]
+    assert stats["serving_failed"] == 0 and stats["serving_overloads"] == 0, \
+        stats
+    assert swap_failures == 0, f"{swap_failures} failed swaps"
+    assert watcher_errors == 0, f"{watcher_errors} watcher errors"
+    assert swaps >= MIN_HOT_SWAPS, (
+        f"only {swaps} hot swaps (need >= {MIN_HOT_SWAPS})")
+    assert crash_fired, (
+        f"scheduled publish crash left no evidence: versions "
+        f"{sorted(versions)}, staging {staging}")
+    if mode == "full":
+        assert preempts_sent, "scheduled preemption never fired"
+        assert any("restart 1/" in m for m in sup_logs), (
+            f"supervisor never restarted after SIGTERM: {sup_logs}")
+        assert stale["staleness_p95_s"] is not None \
+            and stale["staleness_p95_s"] <= stale_bound, (
+            f"staleness p95 {stale['staleness_p95_s']}s exceeds bound "
+            f"{stale_bound:.1f}s")
+
+    import jax
+    report = {
+        "drill": "production_day",
+        "ok": True,
+        "mode": mode,
+        "seed": seed,
+        "pace": pace,
+        "chaos": {
+            "fingerprint": schedule.fingerprint(),
+            "events": json.loads(schedule.to_json())["events"],
+            "publish_crash_fired": crash_fired,
+            "preemptions_sent_at_logical_s": preempts_sent,
+            "supervised_restarts": sum(
+                1 for m in sup_logs if "restart" in m and "/" in m),
+        },
+        "traffic": {
+            "requests": len(plan.requests),
+            "rows": plan.total_rows,
+            "duration_logical_s": params["duration_s"],
+            "base_qps": params["base_qps"],
+            "peak_qps": params["peak_qps"],
+        },
+        "loop_health": {k: v for k, v in counters.items()},
+        "determinism": {
+            "counters_match_simulation": counters_ok,
+            "labels_match_simulation": labels_ok,
+            "audit_fingerprint": _audit_fingerprint(
+                schedule, plan, counters, actual_labels),
+        },
+        "skew": {"records_audited": checker.records_audited,
+                 "mismatches": len(checker.mismatches)},
+        "request_loss": {
+            "failed": stats["serving_failed"] + len(failures),
+            "overloads": stats["serving_overloads"],
+            "hot_swaps": swaps,
+            "swap_failures": swap_failures,
+            "watcher_errors": watcher_errors,
+        },
+        "serving": {k: stats[k] for k in (
+            "serving_requests", "serving_rows", "serving_p50_ms",
+            "serving_p99_ms", "serving_qps", "batch_occupancy_pct",
+            "swap_blackout_ms")},
+        "staleness": dict(
+            stale, covered_rows=len(stale_samples),
+            uncovered_rows=uncovered,
+            bound_s=round(stale_bound, 1),
+            max_publish_gap_s=round(max_gap, 1)),
+        "windowed_auc": windowed_auc(samples, params["auc_windows"],
+                                     params["duration_s"]),
+        "publish": {
+            "versions": sorted(versions),
+            "crashed_version": crashed_version,
+            "staging_leaks": len(staging),
+            "final_params_finite": finite,
+        },
+        "device_kind": jax.devices()[0].platform,
+        "load_kind": "synthetic-diurnal-closed-loop",
+        "baseline_kind": "frozen-bootstrap-v0",
+        "elapsed_s": round(time.time() - t_start, 1),
+    }
+    return report
+
+
+def run_drill(workdir, *, seed=2026, pace=1.0, report_path=None,
+              verbose=True):
+    """The full subprocess drill; writes ``PRODUCTION_r0N.json`` unless
+    ``report_path`` is falsy-but-not-None (pass "" to skip writing)."""
+    say = _say_factory(verbose)
+    os.environ["DEEPFM_TPU_SKIP_TF_EXPORT"] = "1"
+    try:
+        report = _run_core(workdir, mode="full", seed=seed, pace=pace,
+                           say=say)
+    finally:
+        os.environ.pop("DEEPFM_TPU_SKIP_TF_EXPORT", None)
+    if report_path is None:
+        report_path = _next_report_path()
+    if report_path:
+        with open(report_path, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+        say(f"PASS -> {report_path}")
+    return report
+
+
+def run_smoke(workdir, *, seed=11, pace=0.25, verbose=False):
+    """In-process smoke: the same loop with the mini-trainer thread (no
+    subprocess, no SIGTERM) — the tier-1 regression surface."""
+    say = _say_factory(verbose)
+    os.environ["DEEPFM_TPU_SKIP_TF_EXPORT"] = "1"
+    try:
+        return _run_core(workdir, mode="smoke", seed=seed, pace=pace,
+                         say=say)
+    finally:
+        os.environ.pop("DEEPFM_TPU_SKIP_TF_EXPORT", None)
+        faults_lib.set_publish_crash("")  # disarm if the drill died early
+
+
+def _next_report_path():
+    n = 1
+    while os.path.exists(
+            os.path.join(_REPO_ROOT, f"PRODUCTION_r{n:02d}.json")):
+        n += 1
+    return os.path.join(_REPO_ROOT, f"PRODUCTION_r{n:02d}.json")
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--workdir", default="",
+                    help="scratch dir (default: fresh TemporaryDirectory)")
+    ap.add_argument("--seed", type=int, default=2026,
+                    help="drill seed: traffic, label delays, and chaos "
+                         "schedule all derive from it (default 2026)")
+    ap.add_argument("--pace", type=float, default=1.0,
+                    help="wall seconds per logical second (default 1.0)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the fast in-process smoke instead")
+    ap.add_argument("--report", default=None,
+                    help="report path (default: PRODUCTION_r0N.json)")
+    args = ap.parse_args()
+    runner = run_smoke if args.smoke else run_drill
+    kw = dict(seed=args.seed, pace=args.pace, verbose=True)
+    if not args.smoke:
+        kw["report_path"] = args.report
+    if args.workdir:
+        report = runner(args.workdir, **kw)
+    else:
+        with tempfile.TemporaryDirectory(prefix="production_drill_") as d:
+            report = runner(d, **kw)
+    if args.smoke:
+        print(json.dumps(report, indent=2))
+    print("[production_drill] PASS")
+
+
+if __name__ == "__main__":
+    main()

@@ -29,8 +29,8 @@ from deepfm_tpu.utils import preempt as preempt_lib
 
 
 def run_supervised(cmd, *, max_restarts=5, backoff_secs=1.0,
-                   healthy_secs=0.0, sleep=time.sleep, spawn=None,
-                   log=print, clock=time.monotonic):
+                   healthy_secs=0.0, max_total_restarts=0, sleep=time.sleep,
+                   spawn=None, log=print, clock=time.monotonic):
     """Run ``cmd`` until it exits cleanly, restarting on preemption codes.
 
     Returns the final exit code: 0 on success, the child's code on a
@@ -38,19 +38,25 @@ def run_supervised(cmd, *, max_restarts=5, backoff_secs=1.0,
     budget is exhausted. With ``healthy_secs > 0``, a child that ran at
     least that long before a restartable exit resets the restart counter
     and backoff — an online job preempted once a day must not exhaust a
-    lifetime budget sized for crash loops. ``sleep``/``spawn``/``clock``
-    are injectable for tests (``spawn(cmd) -> int`` defaults to
+    lifetime budget sized for crash loops. ``max_total_restarts > 0`` is the
+    crash-loop breaker on top of that: a LIFETIME cap on restarts that
+    ``healthy_secs`` never resets, so a job that keeps limping past the
+    healthy threshold and dying again still stops eventually instead of
+    cycling forever (0 = unlimited). ``sleep``/``spawn``/``clock`` are
+    injectable for tests (``spawn(cmd) -> int`` defaults to
     ``subprocess.call``).
     """
     spawn = spawn if spawn is not None else (lambda c: subprocess.call(c))
     restarts = 0
+    total_restarts = 0
     while True:
         started = clock()
         rc = spawn(cmd)
         ran_secs = clock() - started
         if rc == 0:
-            if restarts:
-                log(f"[supervise] run completed after {restarts} restart(s)")
+            if total_restarts:
+                log(f"[supervise] run completed after {total_restarts} "
+                    f"restart(s)")
             return 0
         if rc not in preempt_lib.RESTARTABLE_EXIT_CODES:
             log(f"[supervise] child failed with non-restartable exit code "
@@ -64,8 +70,14 @@ def run_supervised(cmd, *, max_restarts=5, backoff_secs=1.0,
             log(f"[supervise] restart budget exhausted "
                 f"({restarts}/{max_restarts}); last exit code {rc}")
             return rc
+        if max_total_restarts > 0 and total_restarts >= max_total_restarts:
+            log(f"[supervise] total restart cap reached "
+                f"({total_restarts}/{max_total_restarts}); last exit "
+                f"code {rc}")
+            return rc
         delay = backoff_secs * (2 ** restarts)
         restarts += 1
+        total_restarts += 1
         log(f"[supervise] exit code {rc} "
             f"({'preempted' if rc == preempt_lib.EXIT_PREEMPTED else 'stalled'}"
             f"); restart {restarts}/{max_restarts} in {delay:g}s")
@@ -83,6 +95,10 @@ def main():
                     help="a child that ran at least this long before a "
                          "restartable exit resets the restart counter "
                          "(0 = lifetime budget; default 0)")
+    ap.add_argument("--max_total_restarts", type=int, default=0,
+                    help="crash-loop breaker: lifetime restart cap that "
+                         "--healthy_secs never resets (0 = unlimited; "
+                         "default 0)")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="command to supervise (prefix with --)")
     args = ap.parse_args()
@@ -91,7 +107,8 @@ def main():
         ap.error("no command given (put it after --)")
     return run_supervised(cmd, max_restarts=args.max_restarts,
                           backoff_secs=args.backoff_secs,
-                          healthy_secs=args.healthy_secs)
+                          healthy_secs=args.healthy_secs,
+                          max_total_restarts=args.max_total_restarts)
 
 
 if __name__ == "__main__":

@@ -71,6 +71,11 @@ def pytest_configure(config):
         "embedding: embedding-scale tests (sparse touched-row updates, "
         "hash-bucketed multi-tables, hot/cold tiering); gated on the "
         "backend's scatter-add path being run-to-run deterministic")
+    config.addinivalue_line(
+        "markers",
+        "production: closed-loop production-day drill tests (serve->log->"
+        "join->train->publish feedback loop, chaos schedule, staleness/"
+        "skew/loss gates); the full multi-process drill is also slow")
 
 
 # ---------------------------------------------------------------------------

@@ -148,6 +148,35 @@ class TestSupervisor:
             max_restarts=2, backoff_secs=0.0, healthy_secs=50.0)
         assert rc == 42 and len(left) == 8
 
+    def test_total_cap_breaks_healthy_crash_loop(self):
+        # The pathological case --healthy_secs alone cannot bound: a child
+        # that keeps limping past the healthy threshold and dying again
+        # resets the window budget forever. The lifetime cap still stops it.
+        rc, _, left = self._run_timed(
+            [(100.0, 42)] * 10, max_restarts=2, backoff_secs=0.0,
+            healthy_secs=50.0, max_total_restarts=4)
+        assert rc == 42
+        assert len(left) == 5   # 1 first run + 4 capped restarts
+
+    def test_total_cap_alone_without_healthy_reset(self):
+        # The cap is independent of the per-window budget: a huge
+        # max_restarts doesn't get past it.
+        rc, _, left = self._run([42] * 10, max_restarts=99,
+                                backoff_secs=0.0, max_total_restarts=3)
+        assert rc == 42 and len(left) == 6   # 1 first run + 3 restarts
+
+    def test_total_cap_zero_is_unlimited(self):
+        rc, _, left = self._run_timed(
+            [(100.0, 42)] * 5 + [(100.0, 0)],
+            max_restarts=2, backoff_secs=0.0, healthy_secs=50.0,
+            max_total_restarts=0)
+        assert rc == 0 and left == []
+
+    def test_total_cap_not_hit_on_success(self):
+        rc, _, left = self._run([42, 42, 0], max_restarts=5,
+                                backoff_secs=0.0, max_total_restarts=2)
+        assert rc == 0 and left == []
+
 
 def _state(step=0):
     return {"w": np.arange(8, dtype=np.float32) + step,
