@@ -420,54 +420,91 @@ def online_publish_series() -> dict:
     }
 
 
-def serving_series() -> dict:
-    """Serving runtime under synthetic closed-loop load, with a hot swap
-    mid-run: per-request latency p50/p99, QPS, batch occupancy, and the
-    measured swap blackout (swap instant -> next completed flush).
-
-    Honesty fields mirror the train series: ``device_kind`` names the chip
-    that actually served, and ``load_kind`` labels the traffic as a
-    closed-loop synthetic driver (4 in-process clients, batch 1..32), NOT a
-    production trace — the occupancy/QPS are properties of that load."""
-    import shutil
-    import tempfile
-    import threading
-
-    import jax
-
-    from deepfm_tpu.serve import ServingEngine
+def export_serving_artifacts(workdir: str) -> str:
+    """Two complete bench-config artifacts + LATEST->1 under ``workdir``
+    (the mid-run swap is then a pure pointer move + off-to-the-side load,
+    as in production — the publisher never writes into a live artifact
+    dir). Returns ``workdir``. Split out so a sweep exports ONCE and runs
+    many engine configurations against the same artifacts."""
     from deepfm_tpu.train import Trainer
     from deepfm_tpu.utils import export as export_lib
 
     cfg = _bench_cfg()
     trainer = Trainer(cfg)
     state = trainer.init_state()
-    tmp = tempfile.mkdtemp(prefix="bench_serving_")
-    n_clients, run_secs, max_req = 4, 3.0, 32
     orig_tf = export_lib._export_tf_savedmodel
     export_lib._export_tf_savedmodel = lambda *a, **k: None  # not served
     try:
-        # Two complete artifacts up front; the mid-run swap is then a pure
-        # pointer move + off-to-the-side load, as in production (the
-        # publisher never writes into a live artifact dir).
         for version in ("1", "2"):
             export_lib.export_serving(
-                trainer.model, state, cfg, os.path.join(tmp, version))
-        export_lib.write_latest(tmp, "1")
-        engine = ServingEngine.serve_latest(
-            tmp, poll_secs=0.05, max_batch=256, max_delay_ms=2.0)
+                trainer.model, state, cfg, os.path.join(workdir, version))
+    finally:
+        export_lib._export_tf_savedmodel = orig_tf
+    export_lib.write_latest(workdir, "1")
+    return workdir
+
+
+def serving_series(replicas: int = 1, inflight: int = 2,
+                   small_rows: int = 4, run_secs: float = 3.0,
+                   n_clients: int = 4,
+                   artifact_dir: "str | None" = None) -> dict:
+    """Serving runtime under synthetic closed-loop load, with a hot swap
+    mid-run: per-request latency p50/p99 (global and per priority lane),
+    QPS, batch occupancy, and the measured swap blackout (swap instant ->
+    first completed flush that EXECUTED the new model version).
+
+    Parameterized for the scale-out sweep (``scripts/bench_serving.py``):
+    ``replicas`` > 1 runs a ReplicatedEngine fleet (sticky client
+    affinity, staggered swaps), ``inflight`` sets the pipelined batching
+    depth, ``small_rows`` the priority-lane threshold. ``artifact_dir``
+    reuses pre-exported artifacts (export once, sweep many).
+
+    Honesty fields mirror the train series: ``device_kind`` names the chip
+    that actually served; ``load_kind`` labels the traffic as a
+    closed-loop synthetic driver (``n_clients`` in-process clients, batch
+    1..32), NOT a production trace — occupancy/QPS are properties of that
+    load; ``host_cpu_count`` is what a replica-scaling reading must be
+    judged against (replicas time-slice the same cores on this box)."""
+    import shutil
+    import tempfile
+    import threading
+
+    import jax
+
+    from deepfm_tpu.serve import ReplicatedEngine, ServingEngine
+    from deepfm_tpu.utils import export as export_lib
+
+    cfg = _bench_cfg()
+    max_req = 32
+    tmp = artifact_dir or export_serving_artifacts(
+        tempfile.mkdtemp(prefix="bench_serving_"))
+    export_lib.write_latest(tmp, "1")   # reset for sweep re-entry
+    orig_tf = export_lib._export_tf_savedmodel
+    export_lib._export_tf_savedmodel = lambda *a, **k: None  # not served
+    try:
+        engine_kw = dict(poll_secs=0.05, max_batch=256, max_delay_ms=2.0,
+                         inflight=inflight, small_rows=small_rows)
+        if replicas > 1:
+            engine = ReplicatedEngine.serve_latest(
+                tmp, replicas=replicas, **engine_kw)
+            watchers = [e.watcher for e in engine.engines]
+        else:
+            engine = ServingEngine.serve_latest(tmp, **engine_kw)
+            watchers = [engine.watcher]
         stop = threading.Event()
         failures = []
 
         def client(seed):
             rng = np.random.default_rng(seed)
+            kw = ({"affinity": seed}
+                  if getattr(engine, "supports_affinity", False) else {})
             while not stop.is_set():
                 n = int(rng.integers(1, max_req + 1))
                 ids = rng.integers(0, cfg.feature_size,
                                    (n, cfg.field_size)).astype(np.int32)
                 vals = rng.normal(size=(n, cfg.field_size)).astype(np.float32)
                 try:
-                    engine.predict(ids, vals, timeout=30)
+                    engine.predict(ids, vals, timeout=30, **kw)
                 except Exception as e:  # noqa: BLE001 — the honesty counter
                     failures.append(repr(e))
         threads = [threading.Thread(target=client, args=(s,))
@@ -482,19 +519,33 @@ def serving_series() -> dict:
             stop.set()
             for t in threads:
                 t.join(timeout=30)
-        summary = engine.stats.summary()
-        swaps = engine.watcher.swap_count
-        swap_failures = engine.watcher.swap_failures
+        if replicas > 1:
+            summary = engine.summary()
+            blackout_per_replica = summary["swap_blackout_ms_per_replica"]
+        else:
+            summary = engine.stats.summary()
+            blackout_per_replica = [summary["swap_blackout_ms"]]
+        swaps = min(w.swap_count for w in watchers)
+        swap_failures = sum(w.swap_failures for w in watchers)
         engine.close()
     finally:
         export_lib._export_tf_savedmodel = orig_tf
-        shutil.rmtree(tmp, ignore_errors=True)
+        if artifact_dir is None:
+            shutil.rmtree(tmp, ignore_errors=True)
     return {
+        "replicas": replicas,
+        "serve_inflight": inflight,
+        "serve_small_rows": small_rows,
         "serving_p50_ms": summary["serving_p50_ms"],
         "serving_p99_ms": summary["serving_p99_ms"],
+        "serving_small_p50_ms": summary["serving_small_p50_ms"],
+        "serving_small_p99_ms": summary["serving_small_p99_ms"],
+        "serving_large_p50_ms": summary["serving_large_p50_ms"],
+        "serving_large_p99_ms": summary["serving_large_p99_ms"],
         "serving_qps": summary["serving_qps"],
         "batch_occupancy_pct": summary["batch_occupancy_pct"],
         "swap_blackout_ms": summary["swap_blackout_ms"],
+        "swap_blackout_ms_per_replica": blackout_per_replica,
         "serving_requests": summary["serving_requests"],
         "serving_failed": summary["serving_failed"] + len(failures),
         "serving_overloads": summary["serving_overloads"],
@@ -503,6 +554,7 @@ def serving_series() -> dict:
         "clients": n_clients,
         "load_kind": "synthetic-closed-loop",
         "device_kind": jax.devices()[0].device_kind,
+        "host_cpu_count": os.cpu_count(),
     }
 
 

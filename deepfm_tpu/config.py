@@ -227,6 +227,14 @@ class Config:
     # many seconds aborts with exit code 43 (same contract as
     # dispatch_timeout_s). 0 disables.
     serve_timeout_s: float = 0.0
+    # Pipelined batching depth: how many formed flushes may be handed off
+    # but not yet completed. 1 = strict flush-then-refill (the pre-pipeline
+    # engine); 2 (default) forms flush k+1 while flush k executes.
+    serve_inflight: int = 2
+    # Priority lane: requests of at most this many rows get head-of-line
+    # bypass into every forming batch (never stranded behind a max-batch
+    # fill of large requests). 0 disables the lane.
+    serve_small_rows: int = 0
 
     # ---- mesh / parallelism (replaces TF_CONFIG + horovod knobs) ----
     mesh_data: int = 0                # data-parallel axis size (0 = all devices)
@@ -440,6 +448,14 @@ class Config:
                 "serve_queue_rows must hold at least one serve_max_batch")
         if self.serve_timeout_s < 0:
             raise ValueError("serve_timeout_s must be >= 0")
+        if self.serve_inflight < 1:
+            raise ValueError(
+                "serve_inflight must be >= 1 (1 = strict flush-then-refill)")
+        if not 0 <= self.serve_small_rows <= self.serve_max_batch:
+            raise ValueError(
+                "serve_small_rows must be in 0..serve_max_batch "
+                f"(got {self.serve_small_rows} vs "
+                f"serve_max_batch={self.serve_max_batch})")
         bucket_sizes = self.serve_bucket_sizes
         if any(b < 1 for b in bucket_sizes):
             raise ValueError(

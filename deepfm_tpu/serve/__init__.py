@@ -7,6 +7,9 @@ Layering (heaviest import last — clients can use :mod:`.frontend` and
   * :mod:`.engine` — bounded queue, dynamic batcher, bucketed predict,
     response demux, hot swap via ``utils.export.LatestWatcher`` (the jax
     import happens lazily at engine construction).
+  * :mod:`.replicas` — N engine replicas behind one submit surface: sticky
+    client-affinity routing with least-loaded spill, staggered per-replica
+    hot swap, fleet-aggregate stats.
   * :mod:`.frontend` — N client processes → one device-owning server over
     ``data.shm_ring`` slab rings, with the exit-43 wedge contract.
 """
@@ -14,15 +17,18 @@ Layering (heaviest import last — clients can use :mod:`.frontend` and
 from .engine import ServeFuture, ServerOverloaded, ServingEngine
 from .frontend import (FrontendHandle, FrontendServer, ServingClient,
                        client_main)
-from .stats import ServingStats
+from .replicas import ReplicatedEngine
+from .stats import ServingStats, aggregate_summary
 
 __all__ = [
     "FrontendHandle",
     "FrontendServer",
+    "ReplicatedEngine",
     "ServeFuture",
     "ServerOverloaded",
     "ServingClient",
     "ServingEngine",
     "ServingStats",
+    "aggregate_summary",
     "client_main",
 ]

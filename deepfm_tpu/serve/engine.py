@@ -1,4 +1,5 @@
-"""TPU-native serving engine: queue → dynamic batcher → bucketed predict.
+"""TPU-native serving engine: queue → pipelined dynamic batcher → bucketed
+predict.
 
 The reference delegated serving to TF-Serving (``2-hvd-gpu/...py:429-431``
 exports, a managed endpoint batches); this module is the in-repo engine that
@@ -8,10 +9,18 @@ closes the train→publish→serve loop. One device-owning process runs:
     ``queue_rows`` pending rows and then raises a typed
     :class:`ServerOverloaded` (backpressure a frontend can convert to a 429,
     never a hang);
-  * a **dynamic batcher** — one flush thread waits for the first request,
-    then collects until ``max_batch`` rows arrive (max-batch policy,
-    preempts the deadline) or ``max_delay_ms`` elapses since the FIRST
-    queued request (deadline policy — a lone request is never stranded);
+  * a **priority lane** — requests of at most ``small_rows`` rows queue in
+    a dedicated small lane with head-of-line bypass: every forming batch
+    admits the small lane FIRST, so a cheap latency-sensitive request is
+    never stranded behind a max-batch fill of large requests (0 disables
+    the lane; per-lane p50/p99 land in :class:`ServingStats`);
+  * a **pipelined dynamic batcher** — a batcher thread forms flushes
+    (max-batch policy preempts a deadline anchored at the FIRST queued
+    request across both lanes) and hands them to an executor thread over a
+    bounded in-flight window (``inflight``, default 2): while flush k runs
+    on the device, flush k+1 is already admitting and forming, so batch
+    formation never serializes behind device execution (``inflight=1``
+    restores the strict flush-then-refill pipeline depth);
   * **bucketed batch shapes** — each flush pads to the next bucket
     (``utils.export.padded_predict``), so at most ``len(buckets)`` predict
     programs ever compile no matter what sizes traffic brings;
@@ -28,7 +37,11 @@ and a newly published artifact is loaded off to the side and swapped in with
 one assignment — the flush that is executing keeps the function reference it
 already read, so in-flight batches finish on the old model and no request is
 ever dropped or failed by a swap. A failed load keeps the current model
-(``LatestWatcher.swap_failures`` counts it).
+(``LatestWatcher.swap_failures`` counts it). Each flush is stamped with the
+model VERSION that executed it (``LatestWatcher.current()``), so the
+measured swap blackout is swap→first-flush-of-the-new-version — an
+old-model flush completing after the swap (routine under pipelining) cannot
+close the window early.
 """
 
 from __future__ import annotations
@@ -36,11 +49,11 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Any, Callable, List, Optional, Sequence
+from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .stats import ServingStats
+from .stats import LANE_LARGE, LANE_SMALL, ServingStats
 
 
 class ServerOverloaded(RuntimeError):
@@ -54,13 +67,15 @@ class ServerOverloaded(RuntimeError):
 class ServeFuture:
     """One request's pending result: resolved by the batcher's demux."""
 
-    __slots__ = ("ids", "vals", "n", "t_enqueue", "latency_ms",
+    __slots__ = ("ids", "vals", "n", "lane", "t_enqueue", "latency_ms",
                  "_event", "_probs", "_error")
 
-    def __init__(self, ids: np.ndarray, vals: np.ndarray, t_enqueue: float):
+    def __init__(self, ids: np.ndarray, vals: np.ndarray, t_enqueue: float,
+                 lane: str = LANE_LARGE):
         self.ids = ids
         self.vals = vals
         self.n = int(ids.shape[0])
+        self.lane = lane
         self.t_enqueue = t_enqueue
         self.latency_ms: Optional[float] = None
         self._event = threading.Event()
@@ -93,13 +108,14 @@ class ServeFuture:
 
 
 class ServingEngine:
-    """Bounded queue + dynamic batcher + bucketed jitted predict + demux."""
+    """Bounded queue + pipelined batcher + bucketed jitted predict + demux."""
 
     def __init__(self, predict_fn: Callable[[np.ndarray, np.ndarray],
                                             np.ndarray], *,
                  max_batch: int = 256, max_delay_ms: float = 5.0,
                  queue_rows: int = 0,
                  buckets: Optional[Sequence[int]] = None,
+                 inflight: int = 2, small_rows: int = 0,
                  stats: Optional[ServingStats] = None,
                  clock: Callable[[], float] = time.monotonic,
                  start: bool = True):
@@ -109,14 +125,23 @@ class ServingEngine:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_delay_ms < 0:
             raise ValueError(f"max_delay_ms must be >= 0, got {max_delay_ms}")
+        if inflight < 1:
+            raise ValueError(f"inflight must be >= 1, got {inflight}")
+        if small_rows < 0 or small_rows > max_batch:
+            raise ValueError(
+                f"small_rows must be in 0..max_batch={max_batch}, "
+                f"got {small_rows}")
         self._fn = predict_fn
         self.max_batch = int(max_batch)
         self.max_delay_s = float(max_delay_ms) / 1000.0
+        self.queue_rows_requested = int(queue_rows)
         self.queue_rows = int(queue_rows) if queue_rows else 8 * self.max_batch
         if self.queue_rows < self.max_batch:
             raise ValueError(
                 f"queue_rows ({self.queue_rows}) must hold at least one "
                 f"max_batch ({self.max_batch})")
+        self.inflight = int(inflight)
+        self.small_rows = int(small_rows)
         bucket_src = (buckets if buckets is not None
                       else export_lib.serving_buckets(self.max_batch))
         self.buckets = tuple(sorted({int(b) for b in bucket_src}
@@ -124,15 +149,36 @@ class ServingEngine:
         if self.buckets[0] < 1:
             raise ValueError(f"buckets must be positive, got {buckets}")
         self.stats = stats if stats is not None else ServingStats(clock)
+        self.stats.set_policy(
+            serve_queue_rows=self.queue_rows,
+            serve_queue_rows_auto=(self.queue_rows_requested == 0),
+            serve_inflight=self.inflight,
+            serve_small_rows=self.small_rows)
         self._clock = clock
         self._cond = threading.Condition()
-        self._queue: deque = deque()
+        self._queue: deque = deque()        # large lane (FIFO)
+        self._small: deque = deque()        # priority lane (FIFO, pops first)
         self._queued_rows = 0
         self._closing = False
+        # Pipeline handoff: formed batches wait here for the executor, at
+        # most `inflight` formed-but-uncompleted at any instant.
+        self._exec_cond = threading.Condition()
+        self._exec_queue: deque = deque()
+        self._exec_inflight = 0             # handed off, not yet completed
+        self._exec_done = False             # batcher exited; drain and stop
         self._watcher = None        # owned LatestWatcher (serve_latest)
-        self._thread: Optional[threading.Thread] = None
+        self._batcher: Optional[threading.Thread] = None
+        self._executor: Optional[threading.Thread] = None
         if start:
             self.start()
+
+    def __repr__(self) -> str:
+        qr = (f"{self.queue_rows} (resolved from 0)"
+              if self.queue_rows_requested == 0 else str(self.queue_rows))
+        return (f"ServingEngine(max_batch={self.max_batch}, "
+                f"max_delay_ms={self.max_delay_s * 1000.0:g}, "
+                f"queue_rows={qr}, inflight={self.inflight}, "
+                f"small_rows={self.small_rows}, buckets={self.buckets})")
 
     # ------------------------------------------------------- construction
     @classmethod
@@ -142,6 +188,8 @@ class ServingEngine:
         kw.setdefault("max_batch", cfg.serve_max_batch)
         kw.setdefault("max_delay_ms", cfg.serve_max_delay_ms)
         kw.setdefault("queue_rows", cfg.serve_queue_rows)
+        kw.setdefault("inflight", cfg.serve_inflight)
+        kw.setdefault("small_rows", cfg.serve_small_rows)
         bucket_list = cfg.serve_bucket_sizes
         if bucket_list:
             kw.setdefault("buckets", bucket_list)
@@ -154,12 +202,14 @@ class ServingEngine:
         """Engine following ``<publish_dir>/LATEST`` with hot swap.
 
         The watcher is owned: closed with the engine, and every swap it
-        performs is stamped into the engine's stats (the blackout series).
-        The watcher's loader is bucketed with the ENGINE's own ladder, so
-        the pre-swap warm-up (``LatestWatcher._warm_buckets``) compiles
-        exactly the shapes the engine will flush — the near-zero-blackout
-        contract the serving drill asserts. (The engine pads flushes to
-        the same buckets, so the inner BucketedPredict passes through.)
+        performs is stamped into the engine's stats (the blackout series,
+        versioned — the blackout closes at the first flush that EXECUTED
+        the new version). The watcher's loader is bucketed with the
+        ENGINE's own ladder, so the pre-swap warm-up
+        (``LatestWatcher._warm_buckets``) compiles exactly the shapes the
+        engine will flush — the near-zero-blackout contract the serving
+        drill asserts. (The engine pads flushes to the same buckets, so
+        the inner BucketedPredict passes through.)
         """
         from ..utils import export as export_lib  # lazy: jax-heavy
         stats = kw.pop("stats", None) or ServingStats(
@@ -173,10 +223,24 @@ class ServingEngine:
             path, buckets=resolved))
         wkw.setdefault("on_error",
                        lambda exc: stats.record_watcher_error())
+        # The watcher's initial check_once fires on_swap from inside
+        # watch_latest, before the name `watcher` binds — the box carries
+        # the late binding (the initial load is always version 1).
+        box: list = []
+
+        def _on_swap(path: str) -> None:
+            version = box[0].swap_count if box else 1
+            # Version 1 is the initial LOAD, not a hot swap: nothing was
+            # served before it, so there is no response stream to black
+            # out. (Under staggered replica bring-up, counting it would
+            # report the fleet's slowest initial load as a fake blackout
+            # on the fastest replica.)
+            if version > 1:
+                stats.record_swap(version)
+
         watcher = export_lib.watch_latest(
-            publish_dir, poll_secs=poll_secs,
-            on_swap=lambda path: stats.record_swap(),
-            **wkw)
+            publish_dir, poll_secs=poll_secs, on_swap=_on_swap, **wkw)
+        box.append(watcher)
         engine = cls(watcher, stats=stats, buckets=resolved, **kw)
         engine._watcher = watcher
         return engine
@@ -189,7 +253,8 @@ class ServingEngine:
     def submit(self, feat_ids: np.ndarray,
                feat_vals: np.ndarray) -> ServeFuture:
         """Enqueue one request ``(ids[n,F], vals[n,F])``; returns its
-        future. Raises :class:`ServerOverloaded` when the queue is full or
+        future. Requests of at most ``small_rows`` rows enter the priority
+        lane. Raises :class:`ServerOverloaded` when the queue is full or
         the engine is shutting down, ValueError on malformed shapes."""
         ids = np.asarray(feat_ids)
         vals = np.asarray(feat_vals)
@@ -202,7 +267,9 @@ class ServingEngine:
             raise ValueError(
                 f"request of {n} rows outside 1..max_batch={self.max_batch} "
                 "(split oversized requests client-side)")
-        fut = ServeFuture(ids, vals, self._clock())
+        small = 0 < n <= self.small_rows
+        fut = ServeFuture(ids, vals, self._clock(),
+                          lane=LANE_SMALL if small else LANE_LARGE)
         with self._cond:
             if self._closing:
                 self.stats.record_overload()
@@ -212,7 +279,7 @@ class ServingEngine:
                 raise ServerOverloaded(
                     f"request queue full ({self._queued_rows} rows pending, "
                     f"limit {self.queue_rows}); retry with backoff")
-            self._queue.append(fut)
+            (self._small if small else self._queue).append(fut)
             self._queued_rows += n
             self._cond.notify_all()
         return fut
@@ -224,31 +291,72 @@ class ServingEngine:
 
     # ------------------------------------------------------------ batcher
     def start(self) -> "ServingEngine":
-        if self._thread is None:
-            self._thread = threading.Thread(
-                target=self._run, name="serving-batcher", daemon=True)
-            self._thread.start()
+        if self._batcher is None:
+            self._batcher = threading.Thread(
+                target=self._run_batcher, name="serving-batcher", daemon=True)
+            self._executor = threading.Thread(
+                target=self._run_executor, name="serving-executor",
+                daemon=True)
+            self._batcher.start()
+            self._executor.start()
         return self
 
-    def _run(self) -> None:
+    def _run_batcher(self) -> None:
+        """Form flushes and hand them to the executor over the bounded
+        in-flight window; while flush k executes, flush k+1 forms here."""
         while True:
             batch, rows = self._collect()
             if not batch:
+                with self._exec_cond:
+                    self._exec_done = True
+                    self._exec_cond.notify_all()
                 return  # closed and drained
-            self._flush(batch, rows)
+            with self._exec_cond:
+                while self._exec_inflight >= self.inflight:
+                    self._exec_cond.wait()
+                self._exec_queue.append((batch, rows))
+                self._exec_inflight += 1
+                self._exec_cond.notify_all()
+
+    def _run_executor(self) -> None:
+        while True:
+            with self._exec_cond:
+                while not self._exec_queue and not self._exec_done:
+                    self._exec_cond.wait()
+                if not self._exec_queue:
+                    return  # batcher exited and the pipeline is drained
+                batch, rows = self._exec_queue.popleft()
+            try:
+                self._flush(batch, rows)
+            finally:
+                with self._exec_cond:
+                    self._exec_inflight -= 1
+                    self._exec_cond.notify_all()
+
+    def _head_enqueue_time(self) -> float:
+        """Earliest enqueue time across both lane heads (caller holds
+        ``_cond`` and at least one lane is non-empty)."""
+        heads = [q[0].t_enqueue for q in (self._small, self._queue) if q]
+        return min(heads)
 
     def _collect(self) -> tuple:
-        """Block until a flush is due; pop and return it. Empty = exit."""
+        """Block until a flush is due; pop and return it. Empty = exit.
+
+        The small lane has head-of-line bypass: it fills the batch FIRST,
+        so a priority request is never stranded behind a max-batch fill of
+        larges — worst case it waits out the flush currently forming plus
+        the in-flight window, never a whole queue of large rows.
+        """
         with self._cond:
-            while not self._queue and not self._closing:
+            while not (self._queue or self._small) and not self._closing:
                 self._cond.wait()
-            if not self._queue:
+            if not (self._queue or self._small):
                 return [], 0
             if not self._closing and self.max_delay_s > 0:
-                # Deadline anchored at the FIRST queued request: a single
-                # request waits at most max_delay_ms. A full max_batch of
-                # rows arriving earlier preempts the deadline.
-                deadline = self._queue[0].t_enqueue + self.max_delay_s
+                # Deadline anchored at the FIRST queued request (either
+                # lane): a single request waits at most max_delay_ms. A
+                # full max_batch of rows arriving earlier preempts it.
+                deadline = self._head_enqueue_time() + self.max_delay_s
                 while self._queued_rows < self.max_batch \
                         and not self._closing:
                     remaining = deadline - self._clock()
@@ -257,12 +365,25 @@ class ServingEngine:
                     self._cond.wait(timeout=remaining)
             batch: List[ServeFuture] = []
             rows = 0
+            while self._small and rows + self._small[0].n <= self.max_batch:
+                fut = self._small.popleft()
+                rows += fut.n
+                batch.append(fut)
             while self._queue and rows + self._queue[0].n <= self.max_batch:
                 fut = self._queue.popleft()
                 rows += fut.n
                 batch.append(fut)
             self._queued_rows -= rows
             return batch, rows
+
+    def _snapshot_fn(self) -> Tuple[Callable, Optional[int]]:
+        """The predict fn to execute plus the model version it represents
+        (``LatestWatcher.current()``); a plain fn has no version."""
+        fn = self._fn
+        current = getattr(fn, "current", None)
+        if callable(current):
+            return current()
+        return fn, None
 
     def _flush(self, batch: List[ServeFuture], rows: int) -> None:
         if len(batch) == 1:
@@ -271,9 +392,9 @@ class ServingEngine:
             ids = np.concatenate([f.ids for f in batch])
             vals = np.concatenate([f.vals for f in batch])
         bucket = self._export.next_bucket(rows, self.buckets)
+        fn, version = self._snapshot_fn()
         try:
-            out = self._export.padded_predict(
-                self._fn, ids, vals, self.buckets)
+            out = self._export.padded_predict(fn, ids, vals, self.buckets)
         except Exception as exc:  # noqa: BLE001 — forwarded per-request
             for fut in batch:
                 self.stats.record_request_failed()
@@ -290,7 +411,7 @@ class ServingEngine:
                     {k: v[off:off + fut.n] for k, v in named.items()},
                     latency_ms=1000.0 * (now - fut.t_enqueue))
                 off += fut.n
-                self.stats.record_request_done(fut.latency_ms)
+                self.stats.record_request_done(fut.latency_ms, lane=fut.lane)
         else:
             # Single-output: the historical wire shape [n], bit-unchanged.
             probs = np.asarray(out).reshape(-1)
@@ -298,8 +419,9 @@ class ServingEngine:
                 fut.set_result(probs[off:off + fut.n],
                                latency_ms=1000.0 * (now - fut.t_enqueue))
                 off += fut.n
-                self.stats.record_request_done(fut.latency_ms)
-        self.stats.record_flush(rows, bucket, full=rows >= self.max_batch)
+                self.stats.record_request_done(fut.latency_ms, lane=fut.lane)
+        self.stats.record_flush(rows, bucket, full=rows >= self.max_batch,
+                                version=version)
 
     # ---------------------------------------------------------- lifecycle
     @property
@@ -308,14 +430,18 @@ class ServingEngine:
             return self._queued_rows
 
     def close(self, timeout: Optional[float] = None) -> None:
-        """Stop admitting, DRAIN the queue (every admitted request gets its
-        response), join the batcher, close an owned watcher."""
+        """Stop admitting, DRAIN the queue and the in-flight pipeline
+        (every admitted request gets its response), join both threads,
+        close an owned watcher."""
         with self._cond:
             self._closing = True
             self._cond.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout=timeout)
-            self._thread = None
+        if self._batcher is not None:
+            self._batcher.join(timeout=timeout)
+            self._batcher = None
+        if self._executor is not None:
+            self._executor.join(timeout=timeout)
+            self._executor = None
         if self._watcher is not None:
             self._watcher.close()
 

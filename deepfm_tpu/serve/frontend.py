@@ -221,6 +221,9 @@ class FrontendServer:
         if num_clients < 1:
             raise ValueError("num_clients must be >= 1")
         self._engine = engine
+        # A replicated engine routes by client id (sticky affinity with
+        # least-loaded spill); a single engine ignores the concept.
+        self._affinity = bool(getattr(engine, "supports_affinity", False))
         self.num_clients = int(num_clients)
         self.field_size = int(field_size)
         self.max_rows = int(slab_records if slab_records is not None
@@ -300,7 +303,10 @@ class FrontendServer:
                 ids, vals = slab_ids.copy(), slab_vals.copy()
                 ring.release(slot)
                 try:
-                    fut = self._engine.submit(ids, vals)
+                    if self._affinity:
+                        fut = self._engine.submit(ids, vals, affinity=cid)
+                    else:
+                        fut = self._engine.submit(ids, vals)
                 except (ServerOverloaded, ValueError) as e:
                     self._send_error(cid, req_id, e)
                     continue

@@ -1,0 +1,198 @@
+"""Replica scale-out: N serving engines behind one frontend.
+
+One :class:`~deepfm_tpu.serve.engine.ServingEngine` owns one device (or one
+host time-slice); serving "millions of users" means running several behind
+the same shm_ring frontend. :class:`ReplicatedEngine` presents the ENGINE
+interface the frontend already speaks (``submit`` / ``pending_rows`` /
+``close``) over a fleet of replicas, adding exactly three things:
+
+  * **sticky routing with least-loaded spill** — a request carrying an
+    ``affinity`` key (the frontend passes its client id) lands on the same
+    replica every time, so per-client traffic keeps its admission order and
+    one client's burst warms one replica's batcher. When the sticky replica
+    is overloaded (typed :class:`ServerOverloaded`), the request spills to
+    the least-loaded other replica by pending rows — and only if EVERY
+    replica refuses does the caller see the overload. A closed/dead replica
+    is just a replica that refuses: requests re-route with the same typed
+    error path, never a hang.
+  * **per-replica model slots with STAGGERED hot swap** — each replica owns
+    its own :class:`~deepfm_tpu.utils.export.LatestWatcher` (created with
+    ``start=False``: no per-replica poll threads), and ONE coordinator
+    thread walks the fleet sequentially calling ``check_once()``. A swap —
+    including its off-to-the-side bucket prewarm — completes on replica k
+    before replica k+1 even looks at LATEST, so the fleet never pays all
+    its (already near-zero) blackouts at the same instant and old/new model
+    versions briefly co-serve, exactly like a rolling production rollout.
+  * **aggregate stats** — :func:`~deepfm_tpu.serve.stats.aggregate_summary`
+    over the replicas' reservoirs: true fleet percentiles (concatenated
+    latencies, not averaged percentiles), union-window QPS, and the
+    worst-replica blackout plus the per-replica list the drill gates on.
+
+Scaling honesty: on a time-sliced host (the 1-core CI box) replicas share
+the same core, so aggregate QPS does NOT scale and this module makes no
+claim that it does — the bench series labels those points, per BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .engine import ServeFuture, ServerOverloaded, ServingEngine
+from .stats import aggregate_summary
+
+
+class ReplicatedEngine:
+    """N :class:`ServingEngine` replicas behind one submit() surface."""
+
+    #: The frontend checks this to pass its client id as the sticky key.
+    supports_affinity = True
+
+    def __init__(self, engines: Sequence[ServingEngine], *,
+                 swap_poll_secs: float = 0.0, start: bool = True):
+        engines = list(engines)
+        if not engines:
+            raise ValueError("need at least one replica engine")
+        self._engines = engines
+        self.max_batch = min(e.max_batch for e in engines)
+        self.small_rows = max(e.small_rows for e in engines)
+        self._swap_poll = float(swap_poll_secs)
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        # Routing observability (tests + drill): how many requests each
+        # replica admitted, and how many left their sticky replica.
+        self.routed: List[int] = [0] * len(engines)
+        self.spills = 0
+        self._coordinator: Optional[threading.Thread] = None
+        if start and self._swap_poll > 0 and any(
+                e.watcher is not None for e in engines):
+            self._coordinator = threading.Thread(
+                target=self._run_coordinator, name="replica-swap-coordinator",
+                daemon=True)
+            self._coordinator.start()
+
+    # ------------------------------------------------------- construction
+    @classmethod
+    def serve_latest(cls, publish_dir: str, *, replicas: int = 2,
+                     poll_secs: float = 2.0,
+                     watcher_kw: Optional[dict] = None,
+                     **kw: Any) -> "ReplicatedEngine":
+        """``replicas`` engines, each following ``<publish_dir>/LATEST``
+        through its OWN model slot, swaps staggered by the coordinator.
+
+        Per-replica watchers are created with ``start=False`` — the
+        coordinator thread here is the only poller, and its sequential
+        walk IS the stagger. Engine kwargs (``max_batch``, ``inflight``,
+        ``small_rows``, ...) apply to every replica.
+        """
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        wkw = dict(watcher_kw or {})
+        wkw["start"] = False
+        engines = [ServingEngine.serve_latest(
+            publish_dir, poll_secs=poll_secs, watcher_kw=dict(wkw), **kw)
+            for _ in range(replicas)]
+        return cls(engines, swap_poll_secs=poll_secs)
+
+    # ------------------------------------------------------------ routing
+    @property
+    def engines(self) -> List[ServingEngine]:
+        return list(self._engines)
+
+    @property
+    def replicas(self) -> int:
+        return len(self._engines)
+
+    @property
+    def pending_rows(self) -> int:
+        return sum(e.pending_rows for e in self._engines)
+
+    def _route_order(self, affinity: Optional[int]) -> List[int]:
+        """Sticky replica first (affinity mod N), then the rest by load."""
+        load = [(e.pending_rows, i) for i, e in enumerate(self._engines)]
+        if affinity is None:
+            # No sticky key: pure least-loaded (ties broken by index).
+            return [i for _, i in sorted(load)]
+        home = int(affinity) % len(self._engines)
+        rest = sorted(pair for pair in load if pair[1] != home)
+        return [home] + [i for _, i in rest]
+
+    def submit(self, feat_ids: np.ndarray, feat_vals: np.ndarray,
+               affinity: Optional[int] = None) -> ServeFuture:
+        """Route one request: sticky replica, spill on overload, typed
+        :class:`ServerOverloaded` only when EVERY replica refused.
+        Malformed requests (ValueError) fail fast without re-routing —
+        they would be rejected everywhere."""
+        order = self._route_order(affinity)
+        last: Optional[ServerOverloaded] = None
+        for pos, idx in enumerate(order):
+            try:
+                fut = self._engines[idx].submit(feat_ids, feat_vals)
+            except ServerOverloaded as e:
+                last = e
+                continue
+            with self._lock:
+                self.routed[idx] += 1
+                if affinity is not None and pos > 0:
+                    self.spills += 1
+            return fut
+        assert last is not None
+        raise ServerOverloaded(
+            f"all {len(self._engines)} replicas refused: {last}")
+
+    def predict(self, feat_ids: np.ndarray, feat_vals: np.ndarray,
+                timeout: Optional[float] = None,
+                affinity: Optional[int] = None) -> np.ndarray:
+        return self.submit(feat_ids, feat_vals,
+                           affinity=affinity).result(timeout)
+
+    # ------------------------------------------------------ staggered swap
+    def check_swaps_once(self) -> int:
+        """One sequential stagger pass over the fleet; returns how many
+        replicas swapped. Each ``check_once`` finishes (load + prewarm +
+        swap) before the next replica's begins — at most one replica is
+        ever mid-swap."""
+        swapped = 0
+        for eng in self._engines:
+            watcher = eng.watcher
+            if watcher is None:
+                continue
+            try:
+                if watcher.check_once():
+                    swapped += 1
+            except Exception:  # noqa: BLE001 — poll faults never kill serving
+                eng.stats.record_watcher_error()
+        return swapped
+
+    def _run_coordinator(self) -> None:
+        while not self._stop.wait(self._swap_poll):
+            self.check_swaps_once()
+
+    # -------------------------------------------------------------- stats
+    def summary(self) -> Dict[str, Any]:
+        """Fleet aggregate (true fleet percentiles, union-window QPS,
+        worst-replica + per-replica blackout)."""
+        return aggregate_summary([e.stats for e in self._engines])
+
+    def replica_summaries(self) -> List[Dict[str, Any]]:
+        return [e.stats.summary() for e in self._engines]
+
+    # ---------------------------------------------------------- lifecycle
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Stop the swap coordinator, then drain-close every replica —
+        every admitted future across the fleet resolves."""
+        self._stop.set()
+        if self._coordinator is not None:
+            self._coordinator.join(timeout=timeout)
+            self._coordinator = None
+        for eng in self._engines:
+            eng.close(timeout=timeout)
+
+    def __enter__(self) -> "ReplicatedEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

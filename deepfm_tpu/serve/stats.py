@@ -10,23 +10,36 @@ What the fields mean (the contract ``SERVING_r0*.json`` reports):
   * ``serving_p50_ms`` / ``serving_p99_ms`` — per-request latency from
     ``submit()`` admission to future resolution (queue wait + batch wait +
     predict + demux; the number a client actually experiences).
+  * ``serving_small_p50_ms`` / ``serving_small_p99_ms`` (and the ``large``
+    pair) — the same latency split by priority lane. The small lane exists
+    so a cheap request never queues behind a max-batch fill; its p99 staying
+    at or under the global p99 is the lane's whole job (tier-1 smoke).
   * ``serving_qps`` — completed requests over the first→last completion
     window (steady-state, not including warm-up idle).
   * ``batch_occupancy_pct`` — real rows over padded bucket rows across all
     flushes: 100% means every flush exactly filled its bucket; low values
     mean the deadline fires before batches fill (see TUNING §2.10).
   * ``swap_blackout_ms`` — worst-case time from a hot model swap to the
-    next completed flush. Near-zero is the design goal: the new model loads
-    off to the side, so a swap should never stall the response stream.
+    first completed flush that EXECUTED the new model version. Flushes are
+    stamped with the model version that ran them, so a pre-swap flush
+    completing after the swap (normal under pipelined batching) does not
+    close the window early. Near-zero is the design goal: the new model
+    loads and pre-warms off to the side, so a swap should never stall the
+    response stream.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
+
+#: Lane names the engine stamps requests with. "small" is the priority lane
+#: (row count <= --serve_small_rows); everything else is "large".
+LANE_SMALL = "small"
+LANE_LARGE = "large"
 
 
 def _pct(values: List[float], q: float) -> Optional[float]:
@@ -52,16 +65,32 @@ class ServingStats:
         self.deadline_flushes = 0     # flushes fired by the delay deadline
         self.watcher_errors = 0       # LatestWatcher poll-loop exceptions
         self.latencies_ms: List[float] = []
+        self.lane_latencies_ms: Dict[str, List[float]] = {
+            LANE_SMALL: [], LANE_LARGE: []}
         self.swap_blackouts_ms: List[float] = []
+        # Resolved engine policy, stamped by the engine at construction so
+        # the summary self-documents the configuration that produced it
+        # (the implicit serve_queue_rows=0 -> 8*max_batch resolution made
+        # the effective bound invisible before).
+        self.policy: Dict[str, Any] = {}
         self._first_done: Optional[float] = None
         self._last_done: Optional[float] = None
         self._swap_at: Optional[float] = None
+        self._swap_version: Optional[int] = None
 
     # ------------------------------------------------------------- stamps
-    def record_request_done(self, latency_ms: float) -> None:
+    def set_policy(self, **kw: Any) -> None:
+        """Record resolved engine policy (queue_rows, inflight, ...)."""
+        with self._lock:
+            self.policy.update(kw)
+
+    def record_request_done(self, latency_ms: float,
+                            lane: str = LANE_LARGE) -> None:
         with self._lock:
             self.requests_completed += 1
             self.latencies_ms.append(float(latency_ms))
+            self.lane_latencies_ms.setdefault(lane, []).append(
+                float(latency_ms))
 
     def record_request_failed(self) -> None:
         with self._lock:
@@ -71,10 +100,15 @@ class ServingStats:
         with self._lock:
             self.overloads += 1
 
-    def record_flush(self, rows: int, bucket: int, *,
-                     full: bool = False) -> None:
+    def record_flush(self, rows: int, bucket: int, *, full: bool = False,
+                     version: Optional[int] = None) -> None:
         """One batch flushed through predict: ``rows`` real rows padded to
-        ``bucket``. ``full`` = the max-batch policy fired (vs deadline)."""
+        ``bucket``. ``full`` = the max-batch policy fired (vs deadline).
+        ``version`` = the model version (watcher swap_count) that EXECUTED
+        this flush; under pipelined batching a pre-swap flush may complete
+        after the swap, and only a flush of the new version may close the
+        blackout window. None (no versioned predict fn) keeps the legacy
+        swap→next-completed-flush measure."""
         now = self._clock()
         with self._lock:
             self.flushes += 1
@@ -87,10 +121,13 @@ class ServingStats:
                 self.deadline_flushes += 1
             if self._first_done is None:
                 self._first_done = now
-            if self._swap_at is not None:
+            if self._swap_at is not None and (
+                    version is None or self._swap_version is None
+                    or version >= self._swap_version):
                 self.swap_blackouts_ms.append(
                     1000.0 * max(0.0, now - self._swap_at))
                 self._swap_at = None
+                self._swap_version = None
             self._last_done = now
 
     def record_watcher_error(self) -> None:
@@ -99,12 +136,15 @@ class ServingStats:
         with self._lock:
             self.watcher_errors += 1
 
-    def record_swap(self) -> None:
-        """A hot model swap happened; the next flush closes the blackout
-        window (time the response stream went without a completion)."""
+    def record_swap(self, version: Optional[int] = None) -> None:
+        """A hot model swap happened; the first flush that executed model
+        ``version`` (or newer) closes the blackout window. Without a
+        version, any next flush closes it (the pre-pipelining measure,
+        which under-counts when an old-model flush lands post-swap)."""
         with self._lock:
             if self._swap_at is None:
                 self._swap_at = self._clock()
+                self._swap_version = version
 
     # ------------------------------------------------------------ summary
     def summary(self) -> Dict[str, Any]:
@@ -116,13 +156,20 @@ class ServingStats:
             qps = (self.requests_completed / window if window else None)
             occupancy = (100.0 * self.real_rows / self.padded_rows
                          if self.padded_rows else None)
-            return {
+            small = self.lane_latencies_ms.get(LANE_SMALL, [])
+            large = self.lane_latencies_ms.get(LANE_LARGE, [])
+            out = {
                 "serving_requests": self.requests_completed,
                 "serving_failed": self.requests_failed,
                 "serving_overloads": self.overloads,
                 "serving_rows": self.rows_completed,
                 "serving_p50_ms": _pct(self.latencies_ms, 50),
                 "serving_p99_ms": _pct(self.latencies_ms, 99),
+                "serving_small_requests": len(small),
+                "serving_small_p50_ms": _pct(small, 50),
+                "serving_small_p99_ms": _pct(small, 99),
+                "serving_large_p50_ms": _pct(large, 50),
+                "serving_large_p99_ms": _pct(large, 99),
                 "serving_qps": round(qps, 1) if qps is not None else None,
                 "batch_occupancy_pct": (round(occupancy, 2)
                                         if occupancy is not None else None),
@@ -137,3 +184,74 @@ class ServingStats:
                     round(max(self.swap_blackouts_ms), 3)
                     if self.swap_blackouts_ms else None),
             }
+            out.update(self.policy)
+            return out
+
+
+def aggregate_summary(stats: Sequence[ServingStats]) -> Dict[str, Any]:
+    """Fleet-level summary over N replicas' stats.
+
+    Percentiles are computed over the CONCATENATED latency reservoirs (a
+    true fleet percentile, not an average of per-replica percentiles); QPS
+    uses the union completion window (earliest first-done → latest
+    last-done), so overlapping replicas aggregate instead of double-count;
+    blackout reports the worst replica (the fleet gate is per-replica, and
+    staggered swaps mean the FLEET never sees them all at once — that claim
+    lives with the swap coordinator, not here).
+    """
+    lat: List[float] = []
+    small: List[float] = []
+    large: List[float] = []
+    blackout: List[Optional[float]] = []
+    totals = {"serving_requests": 0, "serving_failed": 0,
+              "serving_overloads": 0, "serving_rows": 0,
+              "serving_flushes": 0, "serving_watcher_errors": 0}
+    first_done: Optional[float] = None
+    last_done: Optional[float] = None
+    real_rows = padded_rows = 0
+    for s in stats:
+        with s._lock:
+            lat.extend(s.latencies_ms)
+            small.extend(s.lane_latencies_ms.get(LANE_SMALL, []))
+            large.extend(s.lane_latencies_ms.get(LANE_LARGE, []))
+            blackout.append(max(s.swap_blackouts_ms)
+                            if s.swap_blackouts_ms else None)
+            totals["serving_requests"] += s.requests_completed
+            totals["serving_failed"] += s.requests_failed
+            totals["serving_overloads"] += s.overloads
+            totals["serving_rows"] += s.rows_completed
+            totals["serving_flushes"] += s.flushes
+            totals["serving_watcher_errors"] += s.watcher_errors
+            real_rows += s.real_rows
+            padded_rows += s.padded_rows
+            if s._first_done is not None:
+                first_done = (s._first_done if first_done is None
+                              else min(first_done, s._first_done))
+            if s._last_done is not None:
+                last_done = (s._last_done if last_done is None
+                             else max(last_done, s._last_done))
+    window = None
+    if (first_done is not None and last_done is not None
+            and last_done > first_done):
+        window = last_done - first_done
+    qps = totals["serving_requests"] / window if window else None
+    known_blackouts = [b for b in blackout if b is not None]
+    out = dict(totals)
+    out.update({
+        "replicas": len(list(stats)),
+        "serving_p50_ms": _pct(lat, 50),
+        "serving_p99_ms": _pct(lat, 99),
+        "serving_small_requests": len(small),
+        "serving_small_p50_ms": _pct(small, 50),
+        "serving_small_p99_ms": _pct(small, 99),
+        "serving_large_p50_ms": _pct(large, 50),
+        "serving_large_p99_ms": _pct(large, 99),
+        "serving_qps": round(qps, 1) if qps is not None else None,
+        "batch_occupancy_pct": (round(100.0 * real_rows / padded_rows, 2)
+                                if padded_rows else None),
+        "swap_blackout_ms": (round(max(known_blackouts), 3)
+                             if known_blackouts else None),
+        "swap_blackout_ms_per_replica": [
+            round(b, 3) if b is not None else None for b in blackout],
+    })
+    return out

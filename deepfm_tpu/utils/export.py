@@ -464,6 +464,12 @@ class LatestWatcher:
         self._on_error = on_error
         self._loader = loader
         self._prewarm = bool(prewarm)
+        # Guards the (fn, current_path, swap_count) triple so current()
+        # returns a CONSISTENT snapshot: a pipelined serving engine stamps
+        # each flush with the version that executed it (the blackout
+        # measure), and a torn read (new fn, old count) would mislabel the
+        # first post-swap flush as pre-swap.
+        self._swap_lock = threading.Lock()
         self._stop = threading.Event()
         self._sleep = sleep if sleep is not None else self._stop.wait
         self._fn: Optional[Callable] = None
@@ -507,12 +513,24 @@ class LatestWatcher:
             ulog.warning(f"hot-swap to {path} deferred ({e}); "
                          "keeping current model")
             return False
-        self._fn = fn  # the swap: one reference assignment
-        self.current_path = path
-        self.swap_count += 1
+        with self._swap_lock:
+            self._fn = fn  # the swap: one reference assignment
+            self.current_path = path
+            self.swap_count += 1
         if self._on_swap is not None:
             self._on_swap(path)
         return True
+
+    def current(self):
+        """Consistent ``(predict_fn, version)`` snapshot, where version is
+        the ``swap_count`` that installed the function. Before the first
+        artifact loads, the fn slot is the watcher itself (calling it
+        raises the typed "no artifact published" error) at version 0. A
+        versioned executor (the pipelined serving engine) uses this to
+        stamp each flush with the model that actually ran it."""
+        with self._swap_lock:
+            fn = self._fn if self._fn is not None else self
+            return fn, self.swap_count
 
     def _warm_buckets(self, fn: Callable) -> None:
         """Drive every serving bucket through the NEW function before it is

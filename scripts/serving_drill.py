@@ -8,16 +8,21 @@ The executable acceptance check for the TPU-native serving runtime
      process and publishes a servable artifact through the production
      ``Publisher`` every few steps — staging dir, atomic rename, ``LATEST``
      pointer — at least 3 versions.
-  2. **Concurrent serving under load.** A ``ServingEngine.serve_latest``
-     over the publish dir serves closed-loop client threads the whole
-     time. The engine must hot-swap through >= 2 version changes (beyond
-     the initial load) with ZERO dropped or failed requests and zero
-     failed swaps — and every returned prob finite and in [0, 1].
-  3. **Near-zero blackout.** The watcher pre-warms every serving bucket
-     off-thread before each one-assignment swap, so the measured
-     swap-to-next-flush blackout must stay under ``MAX_BLACKOUT_MS``
-     (the pre-warm baseline was 239 ms of post-swap compiles,
-     SERVING_r01.json) and ``prewarmed_buckets`` must be > 0.
+  2. **Concurrent serving under load.** A replicated fleet (default 2
+     pipelined engines with a small-request priority lane, sticky client
+     affinity, staggered swaps — ``--replicas 1`` reproduces the single
+     PR 7-style engine) over the publish dir serves closed-loop client
+     threads the whole time. EVERY replica must hot-swap through >= 2
+     version changes (beyond the initial load) with ZERO dropped or
+     failed requests and zero failed swaps — and every returned prob
+     finite and in [0, 1].
+  3. **Near-zero blackout, PER REPLICA.** Each replica's watcher
+     pre-warms every serving bucket off-thread before its one-assignment
+     swap, and the coordinator staggers the fleet (one replica mid-swap
+     at a time), so the measured swap-to-first-new-version-flush blackout
+     must stay under ``MAX_BLACKOUT_MS`` on every replica (the pre-warm
+     baseline was 239 ms of post-swap compiles, SERVING_r01.json) and
+     ``prewarmed_buckets`` must be > 0.
   4. **Bucket parity.** After the run, the final artifact is loaded twice
      — raw and bucket-padded — and the padded outputs must be BIT-EQUAL
      to the unpadded call row-for-row across non-bucket batch sizes.
@@ -41,7 +46,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 from deepfm_tpu.config import Config
-from deepfm_tpu.serve import ServingEngine
+from deepfm_tpu.serve import ReplicatedEngine, ServingEngine
 from deepfm_tpu.train import Trainer
 from deepfm_tpu.train.publish import Publisher
 from deepfm_tpu.utils import export as export_lib
@@ -52,7 +57,10 @@ TRAIN_STEPS = 16
 PUBLISH_EVERY = 4        # versions at steps 4, 8, 12, 16
 N_CLIENTS = 3
 MAX_REQ_ROWS = 24
-MIN_SWAPS = 3            # initial load + >= 2 hot swaps
+REPLICAS = 2             # the fleet under test (1 = the PR 7-style engine)
+INFLIGHT = 2             # pipelined batching depth per replica
+SMALL_ROWS = 4           # priority-lane threshold (exercised under swaps)
+MIN_SWAPS = 3            # initial load + >= 2 hot swaps, PER replica
 # Worst-case swap-to-next-flush gap with bucket pre-warm. The pre-warm
 # baseline measured 239 ms (SERVING_r01.json) — post-swap bucket compiles
 # on the serving path; with the watcher warming every bucket off-thread
@@ -117,12 +125,18 @@ def _publish_while_training(cfg, publish_dir, swap_seen):
 
 def _client_loop(engine, seed, stop, counts, failures):
     rng = np.random.default_rng(seed)
+    # A replicated fleet routes sticky by client id: each drill client
+    # keeps its seed as the affinity key, so every replica sees sustained
+    # traffic (the per-replica blackout gate needs post-swap flushes on
+    # every replica).
+    kw = ({"affinity": seed}
+          if getattr(engine, "supports_affinity", False) else {})
     while not stop.is_set():
         n = int(rng.integers(1, MAX_REQ_ROWS + 1))
         ids = rng.integers(0, FEATURE_SIZE, (n, FIELD_SIZE)).astype(np.int32)
         vals = rng.normal(size=(n, FIELD_SIZE)).astype(np.float32)
         try:
-            probs = engine.predict(ids, vals, timeout=60)
+            probs = engine.predict(ids, vals, timeout=60, **kw)
         except Exception as e:  # noqa: BLE001 — the drill's core assertion
             failures.append(repr(e))
             continue
@@ -153,7 +167,8 @@ def _next_report_path():
     return os.path.join(_REPO_ROOT, f"SERVING_r{n:02d}.json")
 
 
-def run_drill(workdir=None, report_path=None, verbose=True):
+def run_drill(workdir=None, report_path=None, verbose=True,
+              replicas=REPLICAS, inflight=INFLIGHT, small_rows=SMALL_ROWS):
     """The whole drill; returns the report dict (also written to disk)."""
     global say
     if not verbose:
@@ -165,14 +180,26 @@ def run_drill(workdir=None, report_path=None, verbose=True):
     cfg = _tiny_cfg()
     workdir = workdir or tempfile.mkdtemp(prefix="serving_drill_")
     publish_dir = os.path.join(workdir, "publish")
-    say(f"workdir {workdir}")
+    say(f"workdir {workdir} replicas={replicas} inflight={inflight} "
+        f"small_rows={small_rows}")
 
     # Serving side first: it must come up BEFORE any artifact exists and
     # start serving the moment version 1 lands.
-    engine = ServingEngine.serve_latest(
-        publish_dir, poll_secs=0.05,
-        max_batch=cfg.serve_max_batch, max_delay_ms=cfg.serve_max_delay_ms)
-    watcher = engine.watcher
+    engine_kw = dict(
+        poll_secs=0.05, max_batch=cfg.serve_max_batch,
+        max_delay_ms=cfg.serve_max_delay_ms, inflight=inflight,
+        small_rows=small_rows)
+    if replicas > 1:
+        engine = ReplicatedEngine.serve_latest(
+            publish_dir, replicas=replicas, **engine_kw)
+        watchers = [e.watcher for e in engine.engines]
+    else:
+        engine = ServingEngine.serve_latest(publish_dir, **engine_kw)
+        watchers = [engine.watcher]
+    # The publisher's between-version wait counts the SLOWEST replica:
+    # every replica must observe every version (the stagger means they
+    # arrive one after another, never together).
+    fleet_swaps = lambda: min(w.swap_count for w in watchers)  # noqa: E731
     stop = threading.Event()
     counts = [0]
     failures = []
@@ -188,20 +215,21 @@ def run_drill(workdir=None, report_path=None, verbose=True):
     def publisher_thread():
         try:
             versions.extend(_publish_while_training(
-                cfg, publish_dir, swap_seen=lambda: watcher.swap_count))
+                cfg, publish_dir, swap_seen=fleet_swaps))
         except BaseException as e:  # noqa: BLE001 — re-raised in main
             pub_error.append(e)
 
     pub_t = threading.Thread(target=publisher_thread)
     pub_t.start()
-    # Clients start once version 1 is visible (before that, predict fails
-    # by design: there is nothing to serve) and then run across every
-    # subsequent hot swap — the part under test.
+    # Clients start once version 1 is visible on EVERY replica (before
+    # that, predict fails by design: there is nothing to serve) and then
+    # run across every subsequent hot swap — the part under test.
     deadline = time.monotonic() + 120
-    while watcher.swap_count < 1 and time.monotonic() < deadline:
+    while fleet_swaps() < 1 and time.monotonic() < deadline:
         time.sleep(0.02)
-    assert watcher.swap_count >= 1, "first artifact never appeared"
-    say(f"first artifact live ({watcher.current_path}); starting clients")
+    assert fleet_swaps() >= 1, "first artifact never appeared fleet-wide"
+    say(f"first artifact live ({watchers[0].current_path}); "
+        "starting clients")
     for c in clients:
         c.start()
     try:
@@ -218,9 +246,16 @@ def run_drill(workdir=None, report_path=None, verbose=True):
             c.join(timeout=60)
     assert len(versions) >= MIN_SWAPS, versions
 
-    summary = engine.stats.summary()
-    swaps, swap_failures = watcher.swap_count, watcher.swap_failures
-    final_artifact = watcher.current_path
+    if replicas > 1:
+        summary = engine.summary()
+        blackouts = summary["swap_blackout_ms_per_replica"]
+    else:
+        summary = engine.stats.summary()
+        blackouts = [summary["swap_blackout_ms"]]
+    swaps = fleet_swaps()
+    swap_failures = sum(w.swap_failures for w in watchers)
+    prewarmed = sum(w.prewarmed_buckets for w in watchers)
+    final_artifact = watchers[0].current_path
     engine.close()
 
     say(f"requests={counts[0]} failures={len(failures)} swaps={swaps} "
@@ -229,35 +264,47 @@ def run_drill(workdir=None, report_path=None, verbose=True):
     # ---- acceptance ----
     assert not failures, failures[:5]
     assert summary["serving_failed"] == 0, summary
-    assert swaps >= MIN_SWAPS, f"only {swaps} swaps (need >= {MIN_SWAPS})"
+    assert summary["serving_overloads"] == 0, summary
+    assert swaps >= MIN_SWAPS, \
+        f"only {swaps} fleet-wide swaps (need >= {MIN_SWAPS} per replica)"
     assert swap_failures == 0, f"{swap_failures} failed swaps"
     assert counts[0] >= 200, f"only {counts[0]} requests completed"
     assert summary["batch_occupancy_pct"] is not None \
         and summary["batch_occupancy_pct"] > 0, summary
     assert summary["serving_p50_ms"] is not None \
         and summary["serving_p99_ms"] is not None, summary
-    # Near-zero blackout: every bucket was compiled off-thread before the
-    # swap assignment, so no post-swap request pays a compile.
-    assert watcher.prewarmed_buckets > 0, "watcher never pre-warmed a bucket"
-    assert summary["swap_blackout_ms"] is not None \
-        and summary["swap_blackout_ms"] < MAX_BLACKOUT_MS, \
-        f"swap blackout {summary['swap_blackout_ms']}ms >= {MAX_BLACKOUT_MS}ms"
+    # Near-zero blackout ON EVERY REPLICA: each bucket was compiled
+    # off-thread before each swap assignment (no post-swap request pays a
+    # compile), and flushes are version-stamped so a pre-swap flush
+    # completing post-swap (routine under pipelining) cannot close the
+    # window early.
+    assert prewarmed > 0, "no watcher ever pre-warmed a bucket"
+    for i, b in enumerate(blackouts):
+        assert b is not None and b < MAX_BLACKOUT_MS, \
+            f"replica {i} swap blackout {b}ms >= {MAX_BLACKOUT_MS}ms " \
+            f"(per-replica: {blackouts})"
     _assert_bucket_parity(final_artifact)
 
     report = {
         "drill": "serving",
         "ok": True,
+        "replicas": replicas,
+        "serve_inflight": inflight,
+        "serve_small_rows": small_rows,
         "serving_p50_ms": summary["serving_p50_ms"],
         "serving_p99_ms": summary["serving_p99_ms"],
+        "serving_small_p99_ms": summary["serving_small_p99_ms"],
+        "serving_large_p99_ms": summary["serving_large_p99_ms"],
         "serving_qps": summary["serving_qps"],
         "batch_occupancy_pct": summary["batch_occupancy_pct"],
         "swap_blackout_ms": summary["swap_blackout_ms"],
+        "swap_blackout_ms_per_replica": blackouts,
         "serving_requests": summary["serving_requests"],
         "serving_failed": summary["serving_failed"],
         "serving_overloads": summary["serving_overloads"],
         "hot_swaps": swaps,
         "swap_failures": swap_failures,
-        "prewarmed_buckets": watcher.prewarmed_buckets,
+        "prewarmed_buckets": prewarmed,
         "versions_published": versions,
         "clients": N_CLIENTS,
         "load_kind": "synthetic-closed-loop",
@@ -276,8 +323,15 @@ def main():
     ap.add_argument("--report", default=None,
                     help="report path (default: SERVING_r0N.json, next free N)")
     ap.add_argument("--workdir", default=None)
+    ap.add_argument("--replicas", type=int, default=REPLICAS,
+                    help="fleet size (1 = the single PR 7-style engine)")
+    ap.add_argument("--inflight", type=int, default=INFLIGHT,
+                    help="pipelined batching depth per replica")
+    ap.add_argument("--small_rows", type=int, default=SMALL_ROWS,
+                    help="priority-lane row threshold (0 disables)")
     args = ap.parse_args()
-    run_drill(args.workdir, args.report)
+    run_drill(args.workdir, args.report, replicas=args.replicas,
+              inflight=args.inflight, small_rows=args.small_rows)
 
 
 if __name__ == "__main__":

@@ -311,6 +311,224 @@ class TestHotSwap:
         stats.record_flush(4, 4)
         assert stats.summary()["swap_blackout_ms"] == 250.0
 
+    def test_blackout_versioned_overlapped_flush_regression(self):
+        """Under pipelined batching a PRE-swap flush routinely completes
+        AFTER the swap instant. The old swap→next-completed-flush measure
+        let that old-model flush close the window (under-counting); the
+        versioned measure only closes on a flush that EXECUTED the new
+        version."""
+        clock = [0.0]
+        stats = ServingStats(clock=lambda: clock[0])
+        stats.record_flush(4, 4, version=1)
+        stats.record_swap(version=2)            # swap at t=0
+        clock[0] = 0.010
+        stats.record_flush(4, 4, version=1)     # in-flight OLD-model flush
+        assert stats.summary()["swap_blackout_ms"] is None  # window open
+        clock[0] = 0.040
+        stats.record_flush(4, 4, version=2)     # first NEW-model flush
+        assert stats.summary()["swap_blackout_ms"] == 40.0
+
+    def test_blackout_unversioned_keeps_legacy_measure(self):
+        clock = [0.0]
+        stats = ServingStats(clock=lambda: clock[0])
+        stats.record_swap()
+        clock[0] = 0.010
+        stats.record_flush(4, 4)                # no version: any flush closes
+        assert stats.summary()["swap_blackout_ms"] == 10.0
+
+    def test_engine_stamps_flush_with_executing_version(self):
+        """End to end through the engine: flushes carry the version from
+        the predict fn's ``current()`` snapshot, so a swap between two
+        flushes is measured against the version that actually ran."""
+        class VersionedFn:
+            def __init__(self):
+                self.version = 1
+
+            def current(self):
+                v = self.version
+                return (lambda ids, vals: first_col_predict(ids, vals)), v
+
+        fn = VersionedFn()
+        eng = ServingEngine(fn, max_batch=4, max_delay_ms=1)
+        try:
+            eng.predict(*_rows(2), timeout=10)
+            eng.stats.record_swap(version=2)    # swap announced...
+            eng.predict(*_rows(2), timeout=10)  # ...but v1 still executing
+            assert eng.stats.summary()["swap_blackout_ms"] is None
+            fn.version = 2
+            eng.predict(*_rows(2), timeout=10)
+            assert eng.stats.summary()["swap_blackout_ms"] is not None
+        finally:
+            eng.close()
+
+
+# ---------------------------------------------------------------------------
+# Pipelined batching (tentpole layer 1)
+# ---------------------------------------------------------------------------
+
+class TestPipelining:
+    def test_inflight_bound_respected(self):
+        """At most ``inflight`` flushes are ever handed off but not
+        completed, and the batcher keeps forming while one executes."""
+        import threading as th
+        gate = th.Event()
+        concurrent = [0]
+        peak = [0]
+        lock = th.Lock()
+
+        def slow_predict(ids, vals):
+            with lock:
+                concurrent[0] += 1
+                peak[0] = max(peak[0], concurrent[0])
+            gate.wait(5)
+            with lock:
+                concurrent[0] -= 1
+            return first_col_predict(ids, vals)
+
+        eng = ServingEngine(slow_predict, max_batch=2, max_delay_ms=1,
+                            inflight=2)
+        try:
+            futs = [eng.submit(*_rows(2, base=i)) for i in range(6)]
+            time.sleep(0.3)   # batcher forms + hands off while blocked
+            # One executor thread: at most one flush EXECUTES at a time,
+            # but with the executor wedged the handoff window holds a
+            # second formed flush and the batcher has a third in hand —
+            # 3 of the 6 queued batches left the queue while ZERO predict
+            # calls completed. That overlap IS the pipeline.
+            assert not any(f.done() for f in futs)
+            assert eng.pending_rows == 6
+            gate.set()
+            for f in futs:
+                f.result(timeout=10)
+            assert peak[0] == 1
+            assert eng.stats.flushes == 6
+        finally:
+            eng.close()
+
+    def test_inflight_one_reproduces_strict_engine(self):
+        """``inflight=1`` = strict flush-then-refill: identical observable
+        results and per-flush accounting to the PR 7 engine."""
+        eng = ServingEngine(first_col_predict, max_batch=4, max_delay_ms=1,
+                            inflight=1)
+        try:
+            for i in range(4):
+                ids, vals = _rows(3, base=i)
+                np.testing.assert_array_equal(
+                    eng.predict(ids, vals, timeout=10),
+                    first_col_predict(ids, vals))
+            assert eng.stats.requests_completed == 4
+        finally:
+            eng.close()
+
+    def test_close_drains_pipeline_depth(self):
+        """Drain-on-close resolves every admitted future even when several
+        formed flushes are queued behind a slow executor."""
+        def slow_predict(ids, vals):
+            time.sleep(0.05)
+            return first_col_predict(ids, vals)
+
+        eng = ServingEngine(slow_predict, max_batch=2, max_delay_ms=0,
+                            inflight=2, start=False)
+        futs = [eng.submit(*_rows(2, base=i)) for i in range(5)]
+        eng.start()
+        eng.close(timeout=30)
+        for f in futs:
+            assert f.done()
+            assert f.result(timeout=0).shape == (2,)
+
+    def test_repr_surfaces_resolved_policy(self):
+        eng = ServingEngine(first_col_predict, max_batch=16, inflight=3,
+                            small_rows=2, start=False)
+        r = repr(eng)
+        assert "queue_rows=128 (resolved from 0)" in r
+        assert "inflight=3" in r and "small_rows=2" in r
+        eng2 = ServingEngine(first_col_predict, max_batch=16, queue_rows=64,
+                             start=False)
+        assert "queue_rows=64" in repr(eng2)
+        assert "resolved" not in repr(eng2)
+
+    def test_summary_surfaces_resolved_policy(self):
+        eng = ServingEngine(first_col_predict, max_batch=16, start=False)
+        s = eng.stats.summary()
+        assert s["serve_queue_rows"] == 128
+        assert s["serve_queue_rows_auto"] is True
+        assert s["serve_inflight"] == 2
+        assert s["serve_small_rows"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Priority lanes (tentpole layer 2)
+# ---------------------------------------------------------------------------
+
+class TestPriorityLane:
+    def test_small_request_bypasses_large_backlog(self):
+        """A small request admitted behind a queue of max-batch fills rides
+        the NEXT forming batch (head-of-line bypass), not the end of the
+        large backlog."""
+        import threading as th
+        gate = th.Event()
+        first_flush_done = th.Event()
+
+        def gated_predict(ids, vals):
+            if first_flush_done.is_set():
+                gate.wait(5)
+            first_flush_done.set()
+            return first_col_predict(ids, vals)
+
+        eng = ServingEngine(gated_predict, max_batch=4, max_delay_ms=1,
+                            inflight=1, small_rows=1)
+        try:
+            # Backlog: 3 max-batch fills of large requests.
+            larges = [eng.submit(*_rows(4, base=i)) for i in range(3)]
+            small = eng.submit(*_rows(1, base=99))
+            assert small.lane == "small" and larges[0].lane == "large"
+            gate.set()
+            small.result(timeout=10)
+            for f in larges:
+                f.result(timeout=10)
+            # The small request flushed with the FIRST batch formed after
+            # its admission, i.e. before the last large fill completed.
+            order = sorted(
+                [(f.latency_ms, "large") for f in larges]
+                + [(small.latency_ms, "small")])
+            assert order[-1][1] == "large", order
+        finally:
+            eng.close()
+
+    def test_lane_latencies_split_in_summary(self):
+        eng = ServingEngine(first_col_predict, max_batch=8, max_delay_ms=1,
+                            small_rows=2)
+        try:
+            eng.predict(*_rows(1), timeout=10)    # small lane
+            eng.predict(*_rows(5), timeout=10)    # large lane
+            s = eng.stats.summary()
+            assert s["serving_small_requests"] == 1
+            assert s["serving_small_p99_ms"] is not None
+            assert s["serving_large_p99_ms"] is not None
+            assert s["serving_requests"] == 2
+        finally:
+            eng.close()
+
+    def test_lane_disabled_by_default(self):
+        eng = ServingEngine(first_col_predict, max_batch=8, max_delay_ms=1)
+        try:
+            eng.predict(*_rows(1), timeout=10)
+            assert eng.stats.summary()["serving_small_requests"] == 0
+        finally:
+            eng.close()
+
+    def test_small_lane_deadline_anchors_earliest_head(self):
+        """A small request alone still flushes within the deadline (the
+        anchor is the earliest head across BOTH lanes)."""
+        eng = ServingEngine(first_col_predict, max_batch=64, max_delay_ms=20,
+                            small_rows=4)
+        try:
+            t0 = time.monotonic()
+            eng.predict(*_rows(2), timeout=10)
+            assert time.monotonic() - t0 < 5.0
+        finally:
+            eng.close()
+
 
 # ---------------------------------------------------------------------------
 # Config plumbing (satellite 4's flag surface)
@@ -330,6 +548,22 @@ class TestConfig:
         eng = ServingEngine.from_config(Config(serve_max_batch=12),
                                         first_col_predict, start=False)
         assert eng.buckets == (1, 2, 4, 8, 12)
+
+    def test_from_config_carries_pipeline_flags(self):
+        cfg = Config(serve_max_batch=16, serve_inflight=3, serve_small_rows=2)
+        eng = ServingEngine.from_config(cfg, first_col_predict, start=False)
+        assert eng.inflight == 3 and eng.small_rows == 2
+
+    def test_validate_serve_inflight(self):
+        with pytest.raises(ValueError, match="serve_inflight"):
+            Config(serve_inflight=0)
+
+    def test_validate_serve_small_rows(self):
+        with pytest.raises(ValueError, match="serve_small_rows"):
+            Config(serve_small_rows=-1)
+        with pytest.raises(ValueError, match="serve_small_rows"):
+            Config(serve_max_batch=8, serve_small_rows=9)
+        Config(serve_max_batch=8, serve_small_rows=8)  # boundary ok
 
     def test_bad_flags_rejected(self):
         with pytest.raises(ValueError, match="serve_buckets"):
