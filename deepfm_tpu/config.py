@@ -295,6 +295,15 @@ class Config:
     # analog ('' = disabled).
     tensorboard_dir: str = ""
     profile_steps: int = 20           # steps traced per run (bounded window)
+    # Unified telemetry plane (obs/, TUNING.md §2.17). Span tracing over the
+    # host seams (staging ring, input workers, serving batcher, publisher),
+    # exported as Chrome trace_event JSON: off = every site a no-op,
+    # ring = bounded buffer (wraparound drops counted), full = unbounded.
+    trace: str = "off"
+    trace_dir: str = ""               # trace JSON destination ('' = model_dir or cwd)
+    trace_buffer: int = 65536         # ring capacity in events (trace=ring)
+    # Periodic JSONL dump of the unified metrics registry (0 = off).
+    metrics_snapshot_secs: float = 0.0
 
     # ------------------------------------------------------------------
     def __post_init__(self) -> None:
@@ -303,6 +312,13 @@ class Config:
     def validate(self) -> None:
         if self.task_type not in ("train", "eval", "infer", "export"):
             raise ValueError(f"unknown task_type: {self.task_type!r}")
+        if self.trace not in ("off", "ring", "full"):
+            raise ValueError(
+                f"trace must be off|ring|full, got {self.trace!r}")
+        if self.trace_buffer < 1:
+            raise ValueError("trace_buffer must be >= 1")
+        if self.metrics_snapshot_secs < 0:
+            raise ValueError("metrics_snapshot_secs must be >= 0")
         if self.model not in ("deepfm", "widedeep", "dcnv2", "dlrm", "din",
                               "bst"):
             raise ValueError(f"unknown model: {self.model!r}")

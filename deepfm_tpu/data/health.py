@@ -14,6 +14,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional
 
+from ..obs import metrics as metrics_lib
 
 SCALAR_COUNTERS = ("read_retries", "bad_records", "truncated_tails",
                    "bytes_discarded", "late_files", "duplicate_files",
@@ -38,6 +39,8 @@ class DataHealth:
         self.torn_files = 0
         self.per_file: Dict[str, Dict[str, int]] = {}
         self._dirty = False
+        # Unified registry (obs.metrics): snapshot() is the metric surface.
+        metrics_lib.auto_register("data_health", self)
 
     def _file(self, path: str) -> Dict[str, int]:
         entry = self.per_file.get(path)

@@ -45,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import Config
+from ..obs import trace as trace_lib
 from ..utils import faults
 from ..utils import logging as ulog
 
@@ -232,7 +233,7 @@ class TieredEmbeddingRuntime:
         """Plan one dispatch group's cache transaction and remap its
         ``feat_ids`` to hot slot ids. Runs on the staging thread; the cold
         fetches issued here are the prefetch that overlaps device compute."""
-        with self._lock:
+        with trace_lib.span("hotcold.plan"), self._lock:
             return self._plan_group_locked(group)
 
     def _plan_group_locked(self, group):
@@ -374,6 +375,10 @@ class TieredEmbeddingRuntime:
         rows (weights + m/v/tau) into their hot slots."""
         if not self._pending:
             return state
+        with trace_lib.span("hotcold.install"):
+            return self._apply_next_traced(state)
+
+    def _apply_next_traced(self, state):
         t_apply = time.time()
         plan = self._pending.popleft()
         params = dict(state.params)

@@ -47,6 +47,7 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..obs import trace as trace_lib
 from . import shm_ring
 from .health import BadRecordPolicy, DataHealth
 
@@ -110,6 +111,7 @@ def worker_main(worker_id: int, handle: shm_ring.RingHandle,
     ``("error", seq, worker_id, exc_type, detail, health_snapshot)``.
     """
     ring = shm_ring.ShmRing.attach(handle)
+    trace_lib.configure_from_env()  # inherit the parent's --trace settings
     seq = 0
     start_seq = int(opts.get("start_seq", 0))
     die_after = opts.get("fault_die_after")
@@ -138,12 +140,15 @@ def worker_main(worker_id: int, handle: shm_ring.RingHandle,
                 for s in range(0, total, S):
                     e = min(s + S, total)
                     if seq >= start_seq:
-                        slot = ring.acquire()  # blocks = backpressure
+                        with trace_lib.span("input.slab_wait", worker=worker_id):
+                            slot = ring.acquire()  # blocks = backpressure
                         n = e - s
                         labels, ids, vals = ring.arrays(slot, n)
-                        loader.decode_spans_scatter(
-                            buf, offsets[s:e], lengths[s:e], F,
-                            np.arange(n, dtype=np.int64), labels, ids, vals)
+                        with trace_lib.span("input.decode", worker=worker_id,
+                                            records=n):
+                            loader.decode_spans_scatter(
+                                buf, offsets[s:e], lengths[s:e], F,
+                                np.arange(n, dtype=np.int64), labels, ids, vals)
                         del labels, ids, vals
                         ring.send(("chunk", seq, slot, fidx, n, e == total))
                         emitted += 1
@@ -161,8 +166,10 @@ def worker_main(worker_id: int, handle: shm_ring.RingHandle,
                        f"{exc}\n{traceback.format_exc()}", health.snapshot()))
         except Exception:
             pass
+        trace_lib.export()
         ring.close()
         sys.exit(1)
+    trace_lib.export()  # one trace-<pid>.json per worker; parent merges
     ring.close()
 
 
@@ -301,24 +308,32 @@ class ShmInputService:
     def _pop(self, w: int) -> Tuple:
         ring = self._rings[w]
         waited = 0.0
-        while True:
-            try:
-                return ring.pop(timeout=self._poll_secs)
-            except _queue.Empty:
-                pass
-            proc = self._procs[w]
-            if proc is None or not proc.is_alive():
-                try:  # messages flushed just before death are still valid
-                    return ring.pop(timeout=0)
+        # Async span opened lazily on the first empty poll: the common
+        # message-ready case never allocates a trace event.
+        sp = None
+        try:
+            while True:
+                try:
+                    return ring.pop(timeout=self._poll_secs)
                 except _queue.Empty:
-                    raise _WorkerDied(w) from None
-            waited += self._poll_secs
-            if self._stall_timeout_s > 0 and waited >= self._stall_timeout_s:
-                raise InputStallError(
-                    f"input worker {w} is alive but produced no message for "
-                    f"{waited:.1f}s (stall_timeout_s="
-                    f"{self._stall_timeout_s:g}); data health: "
-                    f"{self.health.summary()}")
+                    if sp is None:
+                        sp = trace_lib.begin("input.ring_wait", worker=w)
+                proc = self._procs[w]
+                if proc is None or not proc.is_alive():
+                    try:  # messages flushed just before death are still valid
+                        return ring.pop(timeout=0)
+                    except _queue.Empty:
+                        raise _WorkerDied(w) from None
+                waited += self._poll_secs
+                if self._stall_timeout_s > 0 \
+                        and waited >= self._stall_timeout_s:
+                    raise InputStallError(
+                        f"input worker {w} is alive but produced no message "
+                        f"for {waited:.1f}s (stall_timeout_s="
+                        f"{self._stall_timeout_s:g}); data health: "
+                        f"{self.health.summary()}")
+        finally:
+            trace_lib.end(sp)
 
     def _next_msg(self, w: int) -> Tuple:
         msg = self._pop(w)

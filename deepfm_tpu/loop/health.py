@@ -12,6 +12,7 @@ from __future__ import annotations
 import threading
 from typing import Dict
 
+from ..obs import metrics as metrics_lib
 
 SCALAR_COUNTERS = (
     "impressions_logged",       # rows written to impression shards
@@ -40,6 +41,7 @@ class LoopHealth:
         self._lock = threading.Lock()
         for name in SCALAR_COUNTERS:
             setattr(self, name, 0)
+        metrics_lib.auto_register("loop_health", self)
 
     def record(self, counter: str, n: int = 1) -> None:
         if counter not in SCALAR_COUNTERS:

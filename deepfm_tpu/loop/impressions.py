@@ -16,6 +16,12 @@ two loop-only keys the joiner strips: ``impression_id`` (int64, unique per
 row) and ``served_at_us`` (int64 microseconds on the caller's clock —
 logical drill time or wall time, the logger does not care). The placeholder
 label is 0.0 until the joiner attaches the real one.
+
+Correlation (obs.trace): callers may additionally stamp ``trace_id`` (the
+request's correlation id) and ``model_version`` (the publish version that
+scored the row). Both are optional int64 keys — ``decode_impression`` reads
+only the required keys, and the joiner re-encodes just label/ids/values, so
+stamped shards stay byte-compatible downstream.
 """
 
 from __future__ import annotations
@@ -30,10 +36,14 @@ from .health import LoopHealth
 
 IMPRESSION_ID_KEY = "impression_id"
 SERVED_AT_KEY = "served_at_us"
+TRACE_ID_KEY = "trace_id"
+MODEL_VERSION_KEY = "model_version"
 
 
 def encode_impression(impression_id: int, served_at_s: float,
-                      ids: np.ndarray, vals: np.ndarray) -> bytes:
+                      ids: np.ndarray, vals: np.ndarray, *,
+                      trace_id: Optional[int] = None,
+                      model_version: Optional[int] = None) -> bytes:
     features = {
         example_codec.LABEL_KEY: (np.asarray([0.0], np.float32), "float"),
         example_codec.IDS_KEY: (np.asarray(ids, np.int64), "int64"),
@@ -43,7 +53,24 @@ def encode_impression(impression_id: int, served_at_s: float,
         SERVED_AT_KEY: (
             np.asarray([int(round(served_at_s * 1e6))], np.int64), "int64"),
     }
+    if trace_id is not None:
+        features[TRACE_ID_KEY] = (
+            np.asarray([int(trace_id)], np.int64), "int64")
+    if model_version is not None:
+        features[MODEL_VERSION_KEY] = (
+            np.asarray([int(model_version)], np.int64), "int64")
     return example_codec.encode_example(features)
+
+
+def read_correlation(buf: bytes) -> Tuple[Optional[int], Optional[int]]:
+    """-> (trace_id, model_version) of one impression record (None when the
+    writer did not stamp them)."""
+    feats = example_codec.decode_example(buf)
+    out = []
+    for key in (TRACE_ID_KEY, MODEL_VERSION_KEY):
+        entry = feats.get(key)
+        out.append(None if entry is None else int(np.asarray(entry[1])[0]))
+    return out[0], out[1]
 
 
 def decode_impression(buf: bytes) -> Tuple[int, float, np.ndarray, np.ndarray]:
@@ -104,7 +131,8 @@ class ImpressionLogger:
         return os.path.join(self._dir, f"{self._prefix}-{idx:05d}.tfrecords")
 
     def log(self, impression_id: int, ids: np.ndarray, vals: np.ndarray,
-            served_at_s: float) -> None:
+            served_at_s: float, *, trace_id: Optional[int] = None,
+            model_version: Optional[int] = None) -> None:
         """Log one served row. ``ids``/``vals`` are the arrays the engine
         scored ([F], any integer/float32 dtype)."""
         if self._writer is None:
@@ -113,20 +141,27 @@ class ImpressionLogger:
             self._writer = tfrecord.TFRecordWriter(self._tmp_path)
             self._in_shard = 0
         self._writer.write(
-            encode_impression(impression_id, served_at_s, ids, vals))
+            encode_impression(impression_id, served_at_s, ids, vals,
+                              trace_id=trace_id,
+                              model_version=model_version))
         self._in_shard += 1
         self.health.record("impressions_logged")
         if self._in_shard >= self._shard_records:
             self.flush()
 
     def log_request(self, first_id: int, ids: np.ndarray, vals: np.ndarray,
-                    served_at_s: float) -> List[int]:
+                    served_at_s: float, *,
+                    trace_id: Optional[int] = None,
+                    model_version: Optional[int] = None) -> List[int]:
         """Log every row of one request ``(ids[n,F], vals[n,F])`` with
-        consecutive impression ids starting at ``first_id``; returns them."""
+        consecutive impression ids starting at ``first_id``; returns them.
+        ``trace_id``/``model_version`` stamp every row of the request (the
+        engine resolves one model version per flush)."""
         out = []
         for r in range(int(ids.shape[0])):
             iid = int(first_id) + r
-            self.log(iid, ids[r], vals[r], served_at_s)
+            self.log(iid, ids[r], vals[r], served_at_s,
+                     trace_id=trace_id, model_version=model_version)
             out.append(iid)
         return out
 

@@ -1,0 +1,9 @@
+"""Unified observability plane: span tracing, one metrics registry.
+
+Stdlib-only at import time — ``obs.trace`` and ``obs.metrics`` are imported
+by the spawned input-worker processes, which must not pay (or race on) a
+jax import. ``obs.tensorboard`` pulls in the parallel bootstrap and is
+therefore NOT re-exported here; import it directly where needed.
+"""
+
+from . import metrics, trace  # noqa: F401

@@ -1,0 +1,65 @@
+"""Chief-only TF-summary scalar writer (extracted from ``train/tasks.py``).
+
+The Estimator summary-writer analog (the reference emitted loss summaries
+every ``log_steps``, flag 1-ps-cpu/...py:47). No-op off-chief or when TF is
+unavailable. Beyond the training ``health/*`` scalars it now also carries
+the serving and publisher planes: :meth:`scalar_dict` writes any flat
+stats/summary dict under a prefix (``serving/``, ``publish/``), filtering
+to numeric values so the existing dict surfaces feed it unchanged.
+
+Imports the jax-side bootstrap (chief check) — keep this module OUT of
+``obs/__init__`` so the stdlib-only ``obs.trace``/``obs.metrics`` stay
+importable from spawned worker processes.
+"""
+
+from __future__ import annotations
+
+from ..parallel import bootstrap
+from ..utils import logging as ulog
+
+
+class TensorBoardWriter:
+    """Chief-only TF-summary scalar writer — see module docstring."""
+
+    def __init__(self, logdir: str):
+        self._writer = None
+        if not logdir or not bootstrap.is_chief():
+            return
+        try:
+            import tensorflow as tf  # noqa: PLC0415 (lazy, heavy)
+            try:
+                # TF must not claim accelerators in the JAX process (JAX
+                # preallocates; a TF CUDA init here could OOM the run).
+                tf.config.set_visible_devices([], "GPU")
+            except Exception:
+                pass
+            self._tf = tf
+            self._writer = tf.summary.create_file_writer(logdir)
+        except ImportError:
+            ulog.warning("tensorboard_dir set but tensorflow unavailable; "
+                         "summaries disabled")
+
+    def scalars(self, step: int, **values: float) -> None:
+        if self._writer is None:
+            return
+        with self._writer.as_default(step=step):
+            for name, v in values.items():
+                self._tf.summary.scalar(name, v)
+
+    def scalar_dict(self, step: int, prefix: str, values: dict) -> None:
+        """Write every numeric entry of a stats/summary dict as
+        ``<prefix><key>`` (non-numeric values — policy strings, per-file
+        maps, None — are skipped, so the existing serving ``summary()``
+        and publisher ``stats()`` dicts feed straight through)."""
+        if self._writer is None:
+            return
+        with self._writer.as_default(step=step):
+            for name, v in values.items():
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    continue
+                self._tf.summary.scalar(f"{prefix}{name}", v)
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None

@@ -46,6 +46,7 @@ close the window early.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
@@ -53,6 +54,7 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..obs import trace as trace_lib
 from .stats import LANE_LARGE, LANE_SMALL, ServingStats
 
 
@@ -68,16 +70,18 @@ class ServeFuture:
     """One request's pending result: resolved by the batcher's demux."""
 
     __slots__ = ("ids", "vals", "n", "lane", "t_enqueue", "latency_ms",
-                 "_event", "_probs", "_error")
+                 "trace_id", "model_version", "_event", "_probs", "_error")
 
     def __init__(self, ids: np.ndarray, vals: np.ndarray, t_enqueue: float,
-                 lane: str = LANE_LARGE):
+                 lane: str = LANE_LARGE, trace_id: Optional[int] = None):
         self.ids = ids
         self.vals = vals
         self.n = int(ids.shape[0])
         self.lane = lane
         self.t_enqueue = t_enqueue
         self.latency_ms: Optional[float] = None
+        self.trace_id = trace_id            # correlation id (obs.trace)
+        self.model_version: Optional[int] = None  # stamped by the flush
         self._event = threading.Event()
         self._probs: Optional[np.ndarray] = None
         self._error: Optional[BaseException] = None
@@ -250,12 +254,15 @@ class ServingEngine:
         return self._watcher
 
     # ------------------------------------------------------------- client
-    def submit(self, feat_ids: np.ndarray,
-               feat_vals: np.ndarray) -> ServeFuture:
+    def submit(self, feat_ids: np.ndarray, feat_vals: np.ndarray,
+               trace_id: Optional[int] = None) -> ServeFuture:
         """Enqueue one request ``(ids[n,F], vals[n,F])``; returns its
         future. Requests of at most ``small_rows`` rows enter the priority
-        lane. Raises :class:`ServerOverloaded` when the queue is full or
-        the engine is shutting down, ValueError on malformed shapes."""
+        lane. ``trace_id`` (see ``obs.trace.new_trace_id``) rides the
+        future and is stamped into the flush's trace span for
+        request→model-version correlation. Raises
+        :class:`ServerOverloaded` when the queue is full or the engine is
+        shutting down, ValueError on malformed shapes."""
         ids = np.asarray(feat_ids)
         vals = np.asarray(feat_vals)
         if ids.ndim != 2 or vals.shape != ids.shape:
@@ -269,7 +276,8 @@ class ServingEngine:
                 "(split oversized requests client-side)")
         small = 0 < n <= self.small_rows
         fut = ServeFuture(ids, vals, self._clock(),
-                          lane=LANE_SMALL if small else LANE_LARGE)
+                          lane=LANE_SMALL if small else LANE_LARGE,
+                          trace_id=trace_id)
         with self._cond:
             if self._closing:
                 self.stats.record_overload()
@@ -285,9 +293,11 @@ class ServingEngine:
         return fut
 
     def predict(self, feat_ids: np.ndarray, feat_vals: np.ndarray,
-                timeout: Optional[float] = None) -> np.ndarray:
+                timeout: Optional[float] = None,
+                trace_id: Optional[int] = None) -> np.ndarray:
         """Synchronous convenience: ``submit().result()``."""
-        return self.submit(feat_ids, feat_vals).result(timeout)
+        return self.submit(feat_ids, feat_vals, trace_id=trace_id) \
+            .result(timeout)
 
     # ------------------------------------------------------------ batcher
     def start(self) -> "ServingEngine":
@@ -305,13 +315,15 @@ class ServingEngine:
         """Form flushes and hand them to the executor over the bounded
         in-flight window; while flush k executes, flush k+1 forms here."""
         while True:
-            batch, rows = self._collect()
+            with trace_lib.span("serve.batch") as sp:
+                batch, rows = self._collect()
+                sp.add(rows=rows, requests=len(batch))
             if not batch:
                 with self._exec_cond:
                     self._exec_done = True
                     self._exec_cond.notify_all()
                 return  # closed and drained
-            with self._exec_cond:
+            with trace_lib.span("serve.handoff_wait"), self._exec_cond:
                 while self._exec_inflight >= self.inflight:
                     self._exec_cond.wait()
                 self._exec_queue.append((batch, rows))
@@ -385,6 +397,19 @@ class ServingEngine:
             return current()
         return fn, None
 
+    def _model_step(self) -> Optional[int]:
+        """Artifact step of the CURRENTLY installed model (the basename of
+        ``LatestWatcher.current_path``); None for plain predict fns or
+        non-numeric paths. Read race-tolerantly — a concurrent swap can
+        move the path between flushes, and the span stamp is advisory."""
+        path = getattr(self._fn, "current_path", None)
+        if not path:
+            return None
+        try:
+            return int(os.path.basename(os.path.normpath(path)))
+        except (TypeError, ValueError):
+            return None
+
     def _flush(self, batch: List[ServeFuture], rows: int) -> None:
         if len(batch) == 1:
             ids, vals = batch[0].ids, batch[0].vals
@@ -393,35 +418,55 @@ class ServingEngine:
             vals = np.concatenate([f.vals for f in batch])
         bucket = self._export.next_bucket(rows, self.buckets)
         fn, version = self._snapshot_fn()
-        try:
-            out = self._export.padded_predict(fn, ids, vals, self.buckets)
-        except Exception as exc:  # noqa: BLE001 — forwarded per-request
-            for fut in batch:
-                self.stats.record_request_failed()
-                fut.set_error(exc)
-            return
-        now = self._clock()
-        off = 0
-        if isinstance(out, dict):
-            # Multitask artifact: named per-task probability columns, each
-            # sliced per request — futures resolve with {task: probs[n]}.
-            named = {k: np.asarray(v) for k, v in out.items()}
-            for fut in batch:
-                fut.set_result(
-                    {k: v[off:off + fut.n] for k, v in named.items()},
-                    latency_ms=1000.0 * (now - fut.t_enqueue))
-                off += fut.n
-                self.stats.record_request_done(fut.latency_ms, lane=fut.lane)
-        else:
-            # Single-output: the historical wire shape [n], bit-unchanged.
-            probs = np.asarray(out).reshape(-1)
-            for fut in batch:
-                fut.set_result(probs[off:off + fut.n],
-                               latency_ms=1000.0 * (now - fut.t_enqueue))
-                off += fut.n
-                self.stats.record_request_done(fut.latency_ms, lane=fut.lane)
-        self.stats.record_flush(rows, bucket, full=rows >= self.max_batch,
-                                version=version)
+        step = self._model_step()
+        for fut in batch:
+            # Published artifact step when the watcher serves a versioned
+            # dir (what impressions correlate against); swap ordinal
+            # otherwise.
+            fut.model_version = step if step is not None else version
+        sp = trace_lib.span("serve.flush", rows=rows, bucket=bucket,
+                            requests=len(batch))
+        if version is not None:
+            sp.add(model_version=version)
+        if step is not None:
+            sp.add(model_step=step)
+        tids = [f.trace_id for f in batch if f.trace_id is not None]
+        if tids:
+            sp.add(trace_ids=tids[:64])  # bounded per-event payload
+        with sp:
+            try:
+                out = self._export.padded_predict(fn, ids, vals, self.buckets)
+            except Exception as exc:  # noqa: BLE001 — forwarded per-request
+                for fut in batch:
+                    self.stats.record_request_failed()
+                    fut.set_error(exc)
+                return
+            now = self._clock()
+            off = 0
+            if isinstance(out, dict):
+                # Multitask artifact: named per-task probability columns,
+                # each sliced per request — futures resolve with
+                # {task: probs[n]}.
+                named = {k: np.asarray(v) for k, v in out.items()}
+                for fut in batch:
+                    fut.set_result(
+                        {k: v[off:off + fut.n] for k, v in named.items()},
+                        latency_ms=1000.0 * (now - fut.t_enqueue))
+                    off += fut.n
+                    self.stats.record_request_done(fut.latency_ms,
+                                                   lane=fut.lane)
+            else:
+                # Single-output: the historical wire shape [n], bit-unchanged.
+                probs = np.asarray(out).reshape(-1)
+                for fut in batch:
+                    fut.set_result(probs[off:off + fut.n],
+                                   latency_ms=1000.0 * (now - fut.t_enqueue))
+                    off += fut.n
+                    self.stats.record_request_done(fut.latency_ms,
+                                                   lane=fut.lane)
+            self.stats.record_flush(rows, bucket,
+                                    full=rows >= self.max_batch,
+                                    version=version)
 
     # ---------------------------------------------------------- lifecycle
     @property

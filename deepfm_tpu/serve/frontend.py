@@ -82,10 +82,13 @@ class ServingClient:
 
     # ---------------------------------------------------------- pipelined
     def submit(self, feat_ids: np.ndarray, feat_vals: np.ndarray,
-               timeout: Optional[float] = None) -> int:
+               timeout: Optional[float] = None,
+               trace_id: Optional[int] = None) -> int:
         """Write one request into the ring; returns its ``req_id``.
-        Raises :class:`ServerOverloaded` when the ring is full past
-        ``timeout`` (bounded backpressure, never silent drop)."""
+        ``trace_id`` (``obs.trace.new_trace_id``) rides the wire tuple to
+        the engine for request→model-version correlation. Raises
+        :class:`ServerOverloaded` when the ring is full past ``timeout``
+        (bounded backpressure, never silent drop)."""
         if self._closed:
             raise RuntimeError("client is closed")
         ids = np.asarray(feat_ids)
@@ -110,7 +113,12 @@ class ServingClient:
         req_id = self._next_id
         self._next_id += 1
         self._pending[req_id] = n
-        self._req.send(("req", req_id, slot, n))
+        # 5th element is optional on the wire: old servers unpack 4 and a
+        # None id is simply not sent, so mixed-version rings stay valid.
+        if trace_id is None:
+            self._req.send(("req", req_id, slot, n))
+        else:
+            self._req.send(("req", req_id, slot, n, int(trace_id)))
         return req_id
 
     def recv(self, req_id: int,
@@ -161,8 +169,11 @@ class ServingClient:
 
     # ---------------------------------------------------------- one-shot
     def predict(self, feat_ids: np.ndarray, feat_vals: np.ndarray,
-                timeout: Optional[float] = None) -> np.ndarray:
-        out = self.recv(self.submit(feat_ids, feat_vals, timeout), timeout)
+                timeout: Optional[float] = None,
+                trace_id: Optional[int] = None) -> np.ndarray:
+        out = self.recv(
+            self.submit(feat_ids, feat_vals, timeout, trace_id=trace_id),
+            timeout)
         if isinstance(out, Exception):
             raise out
         return out
@@ -296,7 +307,8 @@ class FrontendServer:
                 if msg[0] == "bye":
                     self._alive[cid] = False
                     break
-                _, req_id, slot, n = msg
+                _, req_id, slot, n = msg[:4]
+                trace_id = msg[4] if len(msg) > 4 else None
                 # Copy out and recycle the slot immediately: the engine may
                 # hold the rows well past this slab's next reuse.
                 _, slab_ids, slab_vals = ring.arrays(slot, n)
@@ -304,9 +316,11 @@ class FrontendServer:
                 ring.release(slot)
                 try:
                     if self._affinity:
-                        fut = self._engine.submit(ids, vals, affinity=cid)
+                        fut = self._engine.submit(ids, vals, affinity=cid,
+                                                  trace_id=trace_id)
                     else:
-                        fut = self._engine.submit(ids, vals)
+                        fut = self._engine.submit(ids, vals,
+                                                  trace_id=trace_id)
                 except (ServerOverloaded, ValueError) as e:
                     self._send_error(cid, req_id, e)
                     continue

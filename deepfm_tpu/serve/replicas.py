@@ -41,6 +41,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..obs import trace as trace_lib
 from .engine import ServeFuture, ServerOverloaded, ServingEngine
 from .stats import aggregate_summary
 
@@ -121,7 +122,8 @@ class ReplicatedEngine:
         return [home] + [i for _, i in rest]
 
     def submit(self, feat_ids: np.ndarray, feat_vals: np.ndarray,
-               affinity: Optional[int] = None) -> ServeFuture:
+               affinity: Optional[int] = None,
+               trace_id: Optional[int] = None) -> ServeFuture:
         """Route one request: sticky replica, spill on overload, typed
         :class:`ServerOverloaded` only when EVERY replica refused.
         Malformed requests (ValueError) fail fast without re-routing —
@@ -130,7 +132,8 @@ class ReplicatedEngine:
         last: Optional[ServerOverloaded] = None
         for pos, idx in enumerate(order):
             try:
-                fut = self._engines[idx].submit(feat_ids, feat_vals)
+                fut = self._engines[idx].submit(feat_ids, feat_vals,
+                                                trace_id=trace_id)
             except ServerOverloaded as e:
                 last = e
                 continue
@@ -138,6 +141,8 @@ class ReplicatedEngine:
                 self.routed[idx] += 1
                 if affinity is not None and pos > 0:
                     self.spills += 1
+                    trace_lib.instant("serve.spill", replica=idx,
+                                      home=order[0], trace_id=trace_id)
             return fut
         assert last is not None
         raise ServerOverloaded(
@@ -145,9 +150,10 @@ class ReplicatedEngine:
 
     def predict(self, feat_ids: np.ndarray, feat_vals: np.ndarray,
                 timeout: Optional[float] = None,
-                affinity: Optional[int] = None) -> np.ndarray:
-        return self.submit(feat_ids, feat_vals,
-                           affinity=affinity).result(timeout)
+                affinity: Optional[int] = None,
+                trace_id: Optional[int] = None) -> np.ndarray:
+        return self.submit(feat_ids, feat_vals, affinity=affinity,
+                           trace_id=trace_id).result(timeout)
 
     # ------------------------------------------------------ staggered swap
     def check_swaps_once(self) -> int:
@@ -174,8 +180,14 @@ class ReplicatedEngine:
     # -------------------------------------------------------------- stats
     def summary(self) -> Dict[str, Any]:
         """Fleet aggregate (true fleet percentiles, union-window QPS,
-        worst-replica + per-replica blackout)."""
-        return aggregate_summary([e.stats for e in self._engines])
+        worst-replica + per-replica blackout/watcher-error lists), plus
+        the per-replica bucket-prewarm counts from the owned watchers
+        (None for a replica serving a plain fn without one)."""
+        out = aggregate_summary([e.stats for e in self._engines])
+        out["prewarmed_buckets_per_replica"] = [
+            getattr(e.watcher, "prewarmed_buckets", None)
+            for e in self._engines]
+        return out
 
     def replica_summaries(self) -> List[Dict[str, Any]]:
         return [e.stats.summary() for e in self._engines]

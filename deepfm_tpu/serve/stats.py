@@ -36,6 +36,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..obs import metrics as metrics_lib
+
 #: Lane names the engine stamps requests with. "small" is the priority lane
 #: (row count <= --serve_small_rows); everything else is "large".
 LANE_SMALL = "small"
@@ -77,6 +79,9 @@ class ServingStats:
         self._last_done: Optional[float] = None
         self._swap_at: Optional[float] = None
         self._swap_version: Optional[int] = None
+        # Unified registry (obs.metrics): the existing summary() IS this
+        # object's metric surface; registration is one weakref'd entry.
+        metrics_lib.auto_register("serving", self)
 
     # ------------------------------------------------------------- stamps
     def set_policy(self, **kw: Any) -> None:
@@ -203,6 +208,7 @@ def aggregate_summary(stats: Sequence[ServingStats]) -> Dict[str, Any]:
     small: List[float] = []
     large: List[float] = []
     blackout: List[Optional[float]] = []
+    watcher_errs: List[int] = []
     totals = {"serving_requests": 0, "serving_failed": 0,
               "serving_overloads": 0, "serving_rows": 0,
               "serving_flushes": 0, "serving_watcher_errors": 0}
@@ -222,6 +228,7 @@ def aggregate_summary(stats: Sequence[ServingStats]) -> Dict[str, Any]:
             totals["serving_rows"] += s.rows_completed
             totals["serving_flushes"] += s.flushes
             totals["serving_watcher_errors"] += s.watcher_errors
+            watcher_errs.append(s.watcher_errors)
             real_rows += s.real_rows
             padded_rows += s.padded_rows
             if s._first_done is not None:
@@ -253,5 +260,8 @@ def aggregate_summary(stats: Sequence[ServingStats]) -> Dict[str, Any]:
                              if known_blackouts else None),
         "swap_blackout_ms_per_replica": [
             round(b, 3) if b is not None else None for b in blackout],
+        # Per-replica fault visibility: an alive-but-failing watcher on ONE
+        # replica is invisible in the fleet total when the others are clean.
+        "serving_watcher_errors_per_replica": watcher_errs,
     })
     return out

@@ -31,6 +31,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
+from ..obs import metrics as metrics_lib
 from ..utils import logging as ulog
 from ..utils import preempt as preempt_lib
 
@@ -55,6 +56,8 @@ class TrainHealth:
         self.loss_spikes = 0          # EMA z-score outliers (warned only)
         self.resume_meta_corrupt = 0  # unreadable resume sidecars tolerated
         self._dirty = False
+        # Unified registry (obs.metrics): snapshot() is the metric surface.
+        metrics_lib.auto_register("train_health", self)
 
     def _bump(self, name: str, n: int = 1) -> None:
         with self._lock:

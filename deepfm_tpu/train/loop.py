@@ -46,6 +46,7 @@ except ImportError:  # jax < 0.5: experimental location, check_rep kwarg
 
 from ..config import Config
 from ..models import get_model
+from ..obs import trace as trace_lib
 from ..ops import embedding as emb_ops
 from ..parallel import mesh as mesh_lib
 from ..utils import logging as ulog
@@ -153,24 +154,26 @@ class _StagingRing:
         """Run one transfer under the slot discipline (staging thread)."""
         self._staged += 1
         if self._staged > self.n_slots:
+            with trace_lib.span("stage.wait", slot=self._staged):
+                t0 = time.time()
+                fence = None
+                # Poll against close so an abandoned fit (exception, early
+                # return) can never strand the staging thread on this queue.
+                while not self._closed.is_set():
+                    try:
+                        fence = self._fences.get(timeout=0.1)
+                        break
+                    except queue.Empty:
+                        continue
+                if fence is not None:
+                    jax.block_until_ready(fence)
+                self.wait_s += time.time() - t0
+        with trace_lib.span("stage.transfer", records=n_records):
             t0 = time.time()
-            fence = None
-            # Poll against close so an abandoned fit (exception, early
-            # return) can never strand the staging thread on this queue.
-            while not self._closed.is_set():
-                try:
-                    fence = self._fences.get(timeout=0.1)
-                    break
-                except queue.Empty:
-                    continue
-            if fence is not None:
-                jax.block_until_ready(fence)
-            self.wait_s += time.time() - t0
-        t0 = time.time()
-        out = transfer()
-        if self._synth_ns and n_records:
-            time.sleep(self._synth_ns * n_records * 1e-9)
-        self.transfer_s += time.time() - t0
+            out = transfer()
+            if self._synth_ns and n_records:
+                time.sleep(self._synth_ns * n_records * 1e-9)
+            self.transfer_s += time.time() - t0
         return out
 
     def retire(self, fence: Any) -> None:
@@ -1311,10 +1314,12 @@ class Trainer:
                     # Donation is off under skip (see __init__), so the
                     # pre-dispatch state stays valid for a dropped update.
                     prev_state, prev_m = state, m
-                if steps_done == 1:
-                    state, m = self.train_step(state, dev_batch)
-                else:
-                    state, m = self.multi_step(state, dev_batch)
+                with trace_lib.span("train.dispatch", steps=steps_done,
+                                    examples=local_ex):
+                    if steps_done == 1:
+                        state, m = self.train_step(state, dev_batch)
+                    else:
+                        state, m = self.multi_step(state, dev_batch)
                 # Slot fence + comms accounting BEFORE the guard verdict: a
                 # skipped dispatch still occupied its staging slot and its
                 # collectives still crossed the fabric.

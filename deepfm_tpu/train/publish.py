@@ -38,6 +38,8 @@ import jax
 import numpy as np
 
 from ..data import fileio
+from ..obs import metrics as metrics_lib
+from ..obs import trace as trace_lib
 from ..utils import export as export_lib
 from ..utils import faults as faults_lib
 from ..utils import logging as ulog
@@ -98,6 +100,8 @@ class Publisher:
         self.skipped_inflight = 0           # due cadences hit while busy
         self.latencies_s: List[float] = []  # submit -> artifact visible
         self.staleness_steps: List[int] = []  # head - version at completion
+        # Unified registry (obs.metrics): stats() is the metric surface.
+        metrics_lib.auto_register("publisher", self)
 
     # ------------------------------------------------------------- cadence
 
@@ -142,10 +146,11 @@ class Publisher:
         """Snapshot ``state`` at ``step`` and publish asynchronously."""
         # Snapshot synchronously: the fit loop donates the state buffers to
         # the next dispatch, so the background job must never touch them.
-        params = jax.tree.map(
-            lambda x: np.asarray(jax.device_get(x)), state.params)
-        mstate = jax.tree.map(
-            lambda x: np.asarray(jax.device_get(x)), state.model_state)
+        with trace_lib.span("publish.snapshot", version=int(step)):
+            params = jax.tree.map(
+                lambda x: np.asarray(jax.device_get(x)), state.params)
+            mstate = jax.tree.map(
+                lambda x: np.asarray(jax.device_get(x)), state.model_state)
         self._inflight_step = int(step)
         self._inflight_since = self._clock()
         self._inflight = self._executor.submit(
@@ -172,15 +177,22 @@ class Publisher:
         snap = _Snap()
         snap.params, snap.model_state, snap.step = params, mstate, step
 
-        if self._extra_export is not None:
-            self._extra_export(staging)
-        export_lib.export_serving(self._model, snap, self._cfg, staging)
-        fileio.fsync_dir(staging)
+        # Spans run on the executor thread — complete ("X") events are
+        # thread-local, so they land on the publisher's own trace row and
+        # the drill's serve-vN-while-vN+1-stages overlap reads directly
+        # off the merged timeline.
+        with trace_lib.span("publish.stage", version=step):
+            if self._extra_export is not None:
+                self._extra_export(staging)
+            export_lib.export_serving(self._model, snap, self._cfg, staging)
+            fileio.fsync_dir(staging)
         faults_lib.check_publish_crash("before_rename")
-        fileio.replace(staging, final_dir)
-        fileio.fsync_dir(self._dir)
+        with trace_lib.span("publish.rename", version=step):
+            fileio.replace(staging, final_dir)
+            fileio.fsync_dir(self._dir)
         faults_lib.check_publish_crash("after_rename_before_latest")
-        self._advance_latest(version)
+        with trace_lib.span("publish.pointer", version=step):
+            self._advance_latest(version)
         return final_dir
 
     def _advance_latest(self, version: str) -> None:

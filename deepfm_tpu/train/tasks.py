@@ -34,6 +34,9 @@ from ..data import fileio
 from ..data import pipeline as pipe_lib
 from ..data import sharding as shard_lib
 from ..data import stream as stream_lib
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+from ..obs.tensorboard import TensorBoardWriter as _TensorBoardWriter
 from ..parallel import bootstrap
 from ..utils import checkpoint as ckpt_lib
 from ..utils import export as export_lib
@@ -405,19 +408,37 @@ def run(cfg: Config) -> Dict[str, float]:
     # Drill seam: env-scripted read faults reach a LAUNCHED subprocess,
     # where the in-process FlakyFS context manager can't (online_drill.py).
     faults_lib.install_env_faults()
+    # Telemetry plane: span tracing (exported as Chrome-trace JSON on the
+    # way out, even on preemption) plus the periodic metrics snapshotter.
+    # configure() also exports env vars so spawned input workers inherit
+    # the mode and write sibling per-pid trace files for merge().
+    obs_dir = cfg.trace_dir or cfg.model_dir or "."
+    obs_trace.configure(cfg.trace, capacity=cfg.trace_buffer,
+                        trace_dir=obs_dir)
+    snap_writer = None
+    if cfg.metrics_snapshot_secs > 0:
+        fileio.makedirs(obs_dir)
+        snap_writer = obs_metrics.SnapshotWriter(
+            os.path.join(obs_dir, f"metrics-{os.getpid()}.jsonl"),
+            cfg.metrics_snapshot_secs)
     ulog.info(
         f"task={cfg.task_type} model={cfg.model} processes="
         f"{jax.process_count()} devices={len(jax.devices())}")
     trainer = Trainer(cfg)
-    if cfg.task_type == "train":
-        return _task_train(trainer, cfg)
-    if cfg.task_type == "eval":
-        return _task_eval(trainer, cfg)
-    if cfg.task_type == "infer":
-        return _task_infer(trainer, cfg)
-    if cfg.task_type == "export":
-        return _task_export(trainer, cfg)
-    raise ValueError(f"unknown task_type {cfg.task_type!r}")
+    try:
+        if cfg.task_type == "train":
+            return _task_train(trainer, cfg)
+        if cfg.task_type == "eval":
+            return _task_eval(trainer, cfg)
+        if cfg.task_type == "infer":
+            return _task_infer(trainer, cfg)
+        if cfg.task_type == "export":
+            return _task_export(trainer, cfg)
+        raise ValueError(f"unknown task_type {cfg.task_type!r}")
+    finally:
+        if snap_writer is not None:
+            snap_writer.close()
+        obs_trace.export()
 
 
 # Multi-process ranks only consult the (rank-local) clock at agreed dispatch
@@ -707,40 +728,8 @@ def _resume_position(cfg: Config, restored_step: int,
     return base + touched, 0, 0
 
 
-class _TensorBoardWriter:
-    """Chief-only TF-summary scalar writer — the Estimator summary-writer
-    analog (the reference emitted loss summaries every ``log_steps``,
-    flag 1-ps-cpu/...py:47). No-op off-chief or when TF is unavailable."""
-
-    def __init__(self, logdir: str):
-        self._writer = None
-        if not logdir or not bootstrap.is_chief():
-            return
-        try:
-            import tensorflow as tf  # noqa: PLC0415 (lazy, heavy)
-            try:
-                # TF must not claim accelerators in the JAX process (JAX
-                # preallocates; a TF CUDA init here could OOM the run).
-                tf.config.set_visible_devices([], "GPU")
-            except Exception:
-                pass
-            self._tf = tf
-            self._writer = tf.summary.create_file_writer(logdir)
-        except ImportError:
-            ulog.warning("tensorboard_dir set but tensorflow unavailable; "
-                         "summaries disabled")
-
-    def scalars(self, step: int, **values: float) -> None:
-        if self._writer is None:
-            return
-        with self._writer.as_default(step=step):
-            for name, v in values.items():
-                self._tf.summary.scalar(name, v)
-
-    def close(self) -> None:
-        if self._writer is not None:
-            self._writer.close()
-            self._writer = None
+# _TensorBoardWriter moved to obs/tensorboard.py (imported above under its
+# old name — tests monkeypatch ``tasks._TensorBoardWriter``).
 
 
 def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
@@ -1104,7 +1093,11 @@ def _task_train(trainer: Trainer, cfg: Config) -> Dict[str, float]:
                         publisher.publish_now(state, final_step)
                         publisher.drain(
                             timeout=cfg.publish_timeout_s or None)
-                    result.update(publisher.stats())
+                    pub_stats = publisher.stats()
+                    result.update(pub_stats)
+                    # Publisher scalars ride the same TB writer as training
+                    # loss/eval (obs.tensorboard) — one place to look.
+                    tb.scalar_dict(final_step, "publish/", pub_stats)
                 if va_files:
                     ev = (online_eval_fn(state) if online_eval_fn is not None
                           else _run_eval(state, "stream eval"))

@@ -21,6 +21,8 @@ import contextlib
 import time
 from typing import Dict, Iterator, List, Optional
 
+from ..obs import metrics as metrics_lib
+
 
 @contextlib.contextmanager
 def maybe_trace(profile_dir: Optional[str]) -> Iterator[None]:
@@ -101,6 +103,9 @@ class HostStageStats:
     def __init__(self) -> None:
         self.ns: Dict[str, int] = {}
         self.records = 0  # caller sets/accumulates the denominator
+        # Unified registry (obs.metrics): per-stage ns/record is the
+        # metric surface.
+        metrics_lib.auto_register("host_stage", self)
 
     @contextlib.contextmanager
     def stage(self, name: str) -> Iterator[None]:

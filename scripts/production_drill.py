@@ -61,6 +61,7 @@ from deepfm_tpu.config import Config
 from deepfm_tpu.loop import (DelayedLabelJoiner, DiurnalTrafficPlan,
                              ImpressionLogger, LoopHealth, SeededLabelFeed,
                              SkewChecker, staleness_summary, windowed_auc)
+from deepfm_tpu.obs import trace as trace_lib
 from deepfm_tpu.serve import ServingEngine
 from deepfm_tpu.train import Trainer
 from deepfm_tpu.train.publish import Publisher
@@ -92,8 +93,9 @@ def _say_factory(verbose):
         if verbose else (lambda msg: None)
 
 
-def _flags(data_dir, model_dir, publish_every, idle_timeout_s):
-    return dict(
+def _flags(data_dir, model_dir, publish_every, idle_timeout_s,
+           trace="off", trace_dir=""):
+    flags = dict(
         task_type="train", data_dir=data_dir, model_dir=model_dir,
         feature_size=FEATURE_SIZE, field_size=FIELD_SIZE, embedding_size=4,
         deep_layers="8", dropout="1.0", batch_size=BATCH_SIZE, num_epochs=1,
@@ -104,6 +106,11 @@ def _flags(data_dir, model_dir, publish_every, idle_timeout_s):
         publish_every_steps=publish_every,
         stream_poll_secs=0.1, stream_idle_timeout_secs=idle_timeout_s,
         serve_max_batch=64, serve_max_delay_ms=3.0)
+    if trace != "off":
+        # The trainer (subprocess in the full drill) writes its own
+        # trace-<pid>.json next to the drill's; merge() stitches them.
+        flags.update(trace=trace, trace_dir=trace_dir)
+    return flags
 
 
 def _cmd(flags):
@@ -354,7 +361,36 @@ def _subprocess_trainer(cmd, env, cell, done_evt, logs, out):
     out["rc"] = rcs[-1]
 
 
-def _run_core(workdir, *, mode, seed, pace, say):
+def _trace_correlation(doc):
+    """The cross-subsystem correlation evidence: a ``serve.flush`` complete
+    event stamped with the artifact step it executed (``model_step=N``)
+    whose wall interval overlaps a ``publish.stage``/``publish.rename``
+    span of a HIGHER version M — i.e. the merged timeline shows a request
+    served by version N while version M was still staging."""
+    serves, publishes = [], []
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        args = ev.get("args") or {}
+        t0 = float(ev.get("ts", 0.0))
+        t1 = t0 + float(ev.get("dur", 0.0))
+        if ev.get("name") == "serve.flush" and "model_step" in args:
+            serves.append((t0, t1, int(args["model_step"]), args))
+        elif ev.get("name") in ("publish.stage", "publish.rename") \
+                and "version" in args:
+            publishes.append((t0, t1, int(args["version"]), ev["name"]))
+    for s0, s1, mstep, sargs in serves:
+        for p0, p1, ver, pname in publishes:
+            if ver > mstep and p0 < s1 and s0 < p1:
+                return {"serve_model_step": mstep,
+                        "publish_version": ver,
+                        "publish_span": pname,
+                        "sample_trace_ids":
+                            list(sargs.get("trace_ids", []))[:4]}
+    return None
+
+
+def _run_core(workdir, *, mode, seed, pace, say, trace="off", tb_dir=""):
     params = FULL if mode == "full" else SMOKE
     t_start = time.time()
     os.makedirs(workdir, exist_ok=True)
@@ -362,7 +398,10 @@ def _run_core(workdir, *, mode, seed, pace, say):
     data_dir = os.path.join(workdir, "data")
     model_dir = os.path.join(workdir, "ckpt")
     publish_dir = os.path.join(model_dir, "publish")
+    trace_dir = os.path.join(workdir, "trace")
     os.makedirs(data_dir, exist_ok=True)
+    if trace != "off":
+        trace_lib.configure(trace, trace_dir=trace_dir)
 
     schedule = faults_lib.ChaosSchedule.generate(
         seed, horizon_s=params["duration_s"],
@@ -390,7 +429,7 @@ def _run_core(workdir, *, mode, seed, pace, say):
                                 health=health)
 
     cfg = Config(**_flags(data_dir, model_dir, params["publish_every"],
-                          params["idle_timeout_s"]))
+                          params["idle_timeout_s"], trace, trace_dir))
     baseline_fn = _bootstrap_v0(cfg, publish_dir, say)
 
     engine = ServingEngine.serve_latest(
@@ -412,7 +451,7 @@ def _run_core(workdir, *, mode, seed, pace, say):
         trainer_thread = threading.Thread(
             target=_subprocess_trainer,
             args=(_cmd(_flags(data_dir, model_dir, params["publish_every"],
-                              params["idle_timeout_s"])),
+                              params["idle_timeout_s"], trace, trace_dir)),
                   env, cell, done_evt, sup_logs, trainer_out))
     else:
         schedule.install(
@@ -494,14 +533,20 @@ def _run_core(workdir, *, mode, seed, pace, say):
         while clock.now() < req.t_s:
             pump(clock.now())
             time.sleep(min(0.005, max(0.0005, 0.002 * pace)))
+        tid = trace_lib.new_trace_id() if trace != "off" else None
         try:
-            probs = engine.predict(req.ids, req.vals, timeout=60)
+            fut = engine.submit(req.ids, req.vals, trace_id=tid)
+            probs = fut.result(timeout=60)
         except Exception as e:  # noqa: BLE001 — the loss gate
             failures.append(f"req@{req.t_s:g}: {e!r}")
             continue
         base = np.asarray(baseline_fn(req.ids, req.vals))
         wall = time.monotonic()
-        iids = logger.log_request(req.first_id, req.ids, req.vals, req.t_s)
+        # Impressions stamp the request's trace_id and the publish version
+        # that scored it — the log side of request→model correlation.
+        iids = logger.log_request(req.first_id, req.ids, req.vals, req.t_s,
+                                  trace_id=tid,
+                                  model_version=fut.model_version)
         for k, iid in enumerate(iids):
             served[iid] = (req.ids[k], req.vals[k])
             served_wall[iid] = wall
@@ -556,6 +601,14 @@ def _run_core(workdir, *, mode, seed, pace, say):
     swap_failures = watcher.swap_failures
     watcher_errors = watcher.watcher_errors
     engine.close()
+    if tb_dir:
+        # Serving-side scalars ride the same writer as the trainer's
+        # (obs.tensorboard) — stepped at the final published version.
+        from deepfm_tpu.obs.tensorboard import TensorBoardWriter
+        tbw = TensorBoardWriter(tb_dir)
+        tbw.scalar_dict(expected_max, "serving/", stats)
+        tbw.scalar_dict(expected_max, "loop/", health.snapshot())
+        tbw.close()
 
     # ---- audits --------------------------------------------------------
     counters = health.snapshot()
@@ -620,6 +673,31 @@ def _run_core(workdir, *, mode, seed, pace, say):
             f"staleness p95 {stale['staleness_p95_s']}s exceeds bound "
             f"{stale_bound:.1f}s")
 
+    # ---- merged trace + correlation gate -------------------------------
+    trace_section = {"mode": trace}
+    if trace != "off":
+        trace_lib.export()  # the drill process's own spans
+        merged_path = trace_lib.merge(
+            trace_dir, os.path.join(trace_dir, "merged_trace.json"))
+        with open(merged_path) as f:
+            merged = json.load(f)
+        correlated = _trace_correlation(merged)
+        assert correlated is not None, (
+            "merged trace shows no serve-vN flush overlapping a "
+            "publish-vM>N staging span")
+        trace_section.update(
+            merged_path=merged_path,
+            merged_from=merged["otherData"]["merged_from"],
+            pids=merged["otherData"]["pids"],
+            events=len(merged["traceEvents"]),
+            dropped_spans=merged["otherData"]["dropped_spans"],
+            correlated_serve_publish_overlap=correlated)
+        say(f"trace: {len(merged['traceEvents'])} events from "
+            f"{merged['otherData']['merged_from']} process(es) -> "
+            f"{merged_path}; serve v{correlated['serve_model_step']} "
+            f"overlapped publish v{correlated['publish_version']} "
+            f"({correlated['publish_span']})")
+
     import jax
     report = {
         "drill": "production_day",
@@ -675,6 +753,7 @@ def _run_core(workdir, *, mode, seed, pace, say):
             "staging_leaks": len(staging),
             "final_params_finite": finite,
         },
+        "trace": trace_section,
         "device_kind": jax.devices()[0].platform,
         "load_kind": "synthetic-diurnal-closed-loop",
         "baseline_kind": "frozen-bootstrap-v0",
@@ -684,16 +763,18 @@ def _run_core(workdir, *, mode, seed, pace, say):
 
 
 def run_drill(workdir, *, seed=2026, pace=1.0, report_path=None,
-              verbose=True):
+              verbose=True, trace="off", tb_dir=""):
     """The full subprocess drill; writes ``PRODUCTION_r0N.json`` unless
     ``report_path`` is falsy-but-not-None (pass "" to skip writing)."""
     say = _say_factory(verbose)
     os.environ["DEEPFM_TPU_SKIP_TF_EXPORT"] = "1"
     try:
         report = _run_core(workdir, mode="full", seed=seed, pace=pace,
-                           say=say)
+                           say=say, trace=trace, tb_dir=tb_dir)
     finally:
         os.environ.pop("DEEPFM_TPU_SKIP_TF_EXPORT", None)
+        if trace != "off":
+            trace_lib.reset()  # don't leak mode/env into the caller
     if report_path is None:
         report_path = _next_report_path()
     if report_path:
@@ -704,17 +785,19 @@ def run_drill(workdir, *, seed=2026, pace=1.0, report_path=None,
     return report
 
 
-def run_smoke(workdir, *, seed=11, pace=0.25, verbose=False):
+def run_smoke(workdir, *, seed=11, pace=0.25, verbose=False, trace="off"):
     """In-process smoke: the same loop with the mini-trainer thread (no
     subprocess, no SIGTERM) — the tier-1 regression surface."""
     say = _say_factory(verbose)
     os.environ["DEEPFM_TPU_SKIP_TF_EXPORT"] = "1"
     try:
         return _run_core(workdir, mode="smoke", seed=seed, pace=pace,
-                         say=say)
+                         say=say, trace=trace)
     finally:
         os.environ.pop("DEEPFM_TPU_SKIP_TF_EXPORT", None)
         faults_lib.set_publish_crash("")  # disarm if the drill died early
+        if trace != "off":
+            trace_lib.reset()  # don't leak mode/env into the caller
 
 
 def _next_report_path():
@@ -738,11 +821,22 @@ def main():
                     help="run the fast in-process smoke instead")
     ap.add_argument("--report", default=None,
                     help="report path (default: PRODUCTION_r0N.json)")
+    ap.add_argument("--trace", default="off",
+                    choices=["off", "ring", "full"],
+                    help="span tracing for every drill process; the report "
+                         "gains a merged Perfetto-loadable trace plus the "
+                         "serve-vN/publish-vN+1 correlation evidence")
+    ap.add_argument("--tb", default="", dest="tb_dir",
+                    help="when set, write serving + loop scalar summaries "
+                         "through the shared TensorBoard writer "
+                         "(obs.tensorboard) into this directory")
     args = ap.parse_args()
     runner = run_smoke if args.smoke else run_drill
-    kw = dict(seed=args.seed, pace=args.pace, verbose=True)
+    kw = dict(seed=args.seed, pace=args.pace, verbose=True,
+              trace=args.trace)
     if not args.smoke:
         kw["report_path"] = args.report
+        kw["tb_dir"] = args.tb_dir
     if args.workdir:
         report = runner(args.workdir, **kw)
     else:

@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Summarize a Chrome trace JSON produced by ``deepfm_tpu.obs.trace``.
+
+Input: one ``trace-<pid>.json`` (per-process export) or a ``merge()``d
+file. Complete ("X") spans are aggregated per name with wall total, SELF
+time (total minus time spent in nested spans on the same thread —
+containment reconstructed per (pid, tid) from ts/dur), and nearest-rank
+p50/p99 of span duration. Async ("b"/"e") spans — cross-thread waits —
+pair by id and aggregate the same way (self == total: they have no
+nesting). Ring-buffer drops recorded at export time are surfaced, never
+hidden: a wrapped ring means the totals undercount.
+
+Usage:
+    python scripts/trace_report.py TRACE.json [--top 20] [--json]
+"""
+
+import argparse
+import collections
+import json
+import sys
+
+
+def _pct(sorted_vals, q):
+    """Nearest-rank percentile of an ascending list (None when empty)."""
+    if not sorted_vals:
+        return None
+    n = len(sorted_vals)
+    return sorted_vals[max(0, -(-q * n // 100) - 1)]
+
+
+def _load(path):
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, list):  # bare event-array form is also loadable
+        return doc, {}
+    return doc.get("traceEvents", []), doc.get("otherData", {})
+
+
+def _self_times(events):
+    """-> {name: [(dur, self)]} for X events, nesting per (pid, tid).
+
+    Within one thread, spans nest by interval containment (a span's
+    children start after it and end before it). Sorting by (ts, -dur)
+    visits parents before their children; a stack of open spans then
+    attributes each child's duration against its direct parent's self
+    time."""
+    per_thread = collections.defaultdict(list)
+    for ev in events:
+        if ev.get("ph") == "X":
+            per_thread[(ev.get("pid"), ev.get("tid"))].append(ev)
+    out = collections.defaultdict(list)
+    for evs in per_thread.values():
+        evs.sort(key=lambda e: (float(e["ts"]), -float(e.get("dur", 0.0))))
+        stack = []  # [name, end_ts, self_us]
+        def close_until(ts):
+            while stack and stack[-1][1] <= ts:
+                name, _, self_us = stack.pop()
+                out[name].append(self_us)
+        for ev in evs:
+            ts = float(ev["ts"])
+            dur = float(ev.get("dur", 0.0))
+            close_until(ts)
+            if stack:
+                stack[-1][2] -= dur  # child time is not parent self time
+            stack.append([ev["name"], ts + dur, dur])
+        close_until(float("inf"))
+    return out
+
+
+def _pair_async(events):
+    """-> ({name: [dur]}, unmatched_count) from b/e pairs keyed by id."""
+    opens, durs, unmatched = {}, collections.defaultdict(list), 0
+    for ev in events:
+        ph = ev.get("ph")
+        if ph == "b":
+            opens[(ev.get("pid"), ev.get("id"))] = ev
+        elif ph == "e":
+            b = opens.pop((ev.get("pid"), ev.get("id")), None)
+            if b is None:
+                unmatched += 1
+            else:
+                durs[b["name"]].append(float(ev["ts"]) - float(b["ts"]))
+    return durs, unmatched + len(opens)
+
+
+def summarize(events):
+    """Aggregate rows: one dict per span name, sorted by self time desc."""
+    x_self = _self_times(events)
+    x_durs = collections.defaultdict(list)
+    for ev in events:
+        if ev.get("ph") == "X":
+            x_durs[ev["name"]].append(float(ev.get("dur", 0.0)))
+    async_durs, unmatched = _pair_async(events)
+    rows = []
+    for name, durs in x_durs.items():
+        durs.sort()
+        rows.append({
+            "name": name, "kind": "span", "count": len(durs),
+            "total_ms": sum(durs) / 1e3,
+            "self_ms": sum(x_self.get(name, ())) / 1e3,
+            "p50_ms": _pct(durs, 50) / 1e3,
+            "p99_ms": _pct(durs, 99) / 1e3,
+        })
+    for name, durs in async_durs.items():
+        durs.sort()
+        total = sum(durs) / 1e3
+        rows.append({
+            "name": name, "kind": "async", "count": len(durs),
+            "total_ms": total, "self_ms": total,
+            "p50_ms": _pct(durs, 50) / 1e3,
+            "p99_ms": _pct(durs, 99) / 1e3,
+        })
+    rows.sort(key=lambda r: -r["self_ms"])
+    instants = collections.Counter(
+        ev["name"] for ev in events if ev.get("ph") == "i")
+    return rows, dict(instants), unmatched
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="trace-<pid>.json or a merged trace file")
+    ap.add_argument("--top", type=int, default=20,
+                    help="rows to print, by self time (default 20)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output instead of the table")
+    args = ap.parse_args(argv)
+
+    events, other = _load(args.trace)
+    rows, instants, unmatched = summarize(events)
+    dropped = int(other.get("dropped_spans", 0))
+
+    if args.json:
+        print(json.dumps({
+            "spans": rows[:args.top], "instants": instants,
+            "unmatched_async": unmatched, "dropped_spans": dropped,
+            "events": len(events), "other": other}, indent=2))
+        return 0
+
+    print(f"{len(events)} events"
+          + (f" from pids {other['pids']}" if "pids" in other else "")
+          + (f"; {dropped} spans DROPPED to ring wraparound"
+             if dropped else ""))
+    if unmatched:
+        print(f"{unmatched} async begin/end events unpaired "
+              "(in flight at export, or partner lost to the ring)")
+    header = (f"{'span':<24}{'kind':<7}{'count':>7}{'total_ms':>11}"
+              f"{'self_ms':>10}{'p50_ms':>9}{'p99_ms':>9}")
+    print(header)
+    print("-" * len(header))
+    for r in rows[:args.top]:
+        print(f"{r['name']:<24}{r['kind']:<7}{r['count']:>7}"
+              f"{r['total_ms']:>11.2f}{r['self_ms']:>10.2f}"
+              f"{r['p50_ms']:>9.3f}{r['p99_ms']:>9.3f}")
+    for name, n in sorted(instants.items()):
+        print(f"instant {name}: {n}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -519,14 +519,38 @@ def _assert_drill_gates(r):
 
 def test_production_smoke_closed_loop(tmp_path):
     """Tier-1 drill: the whole serve->log->join->train->publish loop in one
-    process (mini-trainer thread), with the scheduled publish crash live."""
-    r = production_drill.run_smoke(str(tmp_path), verbose=False)
+    process (mini-trainer thread), with the scheduled publish crash live —
+    run under --trace ring, which must change nothing about the gates and
+    must leave a merged, correlated Chrome trace."""
+    r = production_drill.run_smoke(str(tmp_path), verbose=False,
+                                   trace="ring")
     assert r["mode"] == "smoke"
     _assert_drill_gates(r)
     # The online trainer actually trained: versions beyond bootstrap exist
     # and staleness was measured for covered rows.
     assert max(r["publish"]["versions"]) >= 3 * 4
     assert r["staleness"]["covered_rows"] > 0
+    # Telemetry plane: one merged Perfetto-loadable trace whose timeline
+    # shows a request served by version N while version M > N staged.
+    tr = r["trace"]
+    assert tr["mode"] == "ring"
+    assert os.path.exists(tr["merged_path"])
+    with open(tr["merged_path"]) as f:
+        merged = json.load(f)
+    assert len(merged["traceEvents"]) == tr["events"] > 0
+    corr = tr["correlated_serve_publish_overlap"]
+    assert corr["publish_version"] > corr["serve_model_step"]
+    assert corr["sample_trace_ids"], "no trace_ids reached the flush"
+    # trace_report digests the merged file: the hot serving/publish spans
+    # appear with counts and self-time.
+    import trace_report
+    rows, _, _ = trace_report.summarize(merged["traceEvents"])
+    names = {row["name"] for row in rows}
+    assert "serve.flush" in names and "publish.stage" in names
+    # The drill reset the global tracer on the way out (no env leak).
+    from deepfm_tpu.obs import trace as trace_lib
+    assert not trace_lib.enabled()
+    assert trace_lib.ENV_MODE not in os.environ
 
 
 @pytest.mark.slow
