@@ -639,6 +639,230 @@ def serving_series(replicas: int = 1, inflight: int = 2,
     }
 
 
+#: Fleet shape shared by the saturation probe and every flood point — a
+#: deliberately SMALL queue (512 rows -> 256-row shed watermark) so the
+#: post-window drain stays short and the admission gate, not the queue
+#: depth, is what absorbs the flood.
+_FLOOD_ENGINE_KW = dict(poll_secs=5.0, max_batch=64, max_delay_ms=2.0,
+                        inflight=2, small_rows=0, queue_rows=512)
+
+
+def serving_saturation_qps(artifact_dir: str, *, replicas: int = 2,
+                           probe_secs: float = 1.5,
+                           n_clients: int = 32,
+                           warmup_secs: float = 0.4) -> float:
+    """Measured saturation throughput for the flood fleet shape: a short
+    closed-loop probe (``n_clients`` threads, 1-row requests — the flood
+    plan's request shape) against the SAME engine configuration the
+    overload series floods, with no admission gate and no hedging, so the
+    number is the fleet's raw service rate. ``n_clients`` is the in-flight
+    depth — it must be large enough to fill the batcher's buckets, or the
+    probe measures round-trip serialization instead of service rate — and
+    ``warmup_secs`` keeps bucket JIT compiles out of the measured window.
+    The flood sweep expresses its offered loads as multiples of this
+    measurement — "4x saturation" means the same thing on a laptop and a
+    TPU host."""
+    import threading
+
+    from deepfm_tpu.serve import ReplicatedEngine
+
+    cfg = _bench_cfg()
+    engine = ReplicatedEngine.serve_latest(
+        artifact_dir, replicas=replicas, **_FLOOD_ENGINE_KW)
+    stop = threading.Event()
+    done = [0] * n_clients
+
+    def client(k):
+        rng = np.random.default_rng(k)
+        while not stop.is_set():
+            ids = rng.integers(0, cfg.feature_size,
+                               (1, cfg.field_size)).astype(np.int32)
+            vals = rng.normal(size=(1, cfg.field_size)).astype(np.float32)
+            try:
+                engine.predict(ids, vals, timeout=30, affinity=k)
+                done[k] += 1
+            except Exception:  # noqa: BLE001 — probe counts successes only
+                pass
+
+    threads = [threading.Thread(target=client, args=(k,))
+               for k in range(n_clients)]
+    for t in threads:
+        t.start()
+    time.sleep(warmup_secs)
+    base = sum(done)
+    t0 = time.monotonic()
+    time.sleep(probe_secs)
+    count = sum(done) - base
+    elapsed = max(time.monotonic() - t0, 1e-9)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    engine.close()
+    return max(1.0, count / elapsed)
+
+
+def overload_point(engine, plan, *, slo_ms: float,
+                   resolve_timeout_s: float) -> dict:
+    """Drive one ``FloodTrafficPlan`` open-loop against a live fleet and
+    tally the full accounting: every offered request ends as exactly ONE
+    of completed / shed / overload / timeout / failed — the
+    zero-silent-drop identity the flood gate asserts (``accounting_ok``).
+
+    Open-loop means the driver submits on the plan's clock regardless of
+    completions — past saturation it does NOT self-throttle, which is the
+    whole point; ``offered_qps_achieved`` records what the single-threaded
+    submitter actually sustained so a fast plan on a slow host is labeled
+    rather than silently rescaled. Goodput counts only in-SLO completions
+    over the offered window."""
+    from deepfm_tpu.serve import (AdmissionShed, ServerOverloaded,
+                                  ServeTimeout)
+
+    futs = []
+    sheds = overloads = 0
+    t0 = time.monotonic()
+    for r in plan.requests:
+        wait = t0 + r.t_s - time.monotonic()
+        if wait > 0:
+            time.sleep(wait)
+        try:
+            futs.append(engine.submit(r.ids, r.vals, affinity=r.user_id,
+                                      value=r.value))
+        except AdmissionShed:
+            sheds += 1
+        except ServerOverloaded:
+            overloads += 1
+    submit_elapsed = max(time.monotonic() - t0, 1e-9)
+    completed = in_slo = timeouts = failed = 0
+    lat: list = []
+    deadline = time.monotonic() + resolve_timeout_s
+    for fut in futs:
+        try:
+            fut.result(timeout=max(0.05, deadline - time.monotonic()))
+        except ServeTimeout:
+            timeouts += 1
+            fut.cancel()
+            continue
+        except Exception:  # noqa: BLE001 — typed into the identity
+            failed += 1
+            continue
+        completed += 1
+        ms = fut.latency_ms
+        if ms is not None:
+            lat.append(ms)
+            if ms <= slo_ms:
+                in_slo += 1
+    offered = len(plan.requests)
+    lat.sort()
+    return {
+        "offered_requests": offered,
+        "offered_qps_target": round(plan.offered_qps, 1),
+        "offered_qps_achieved": round(offered / submit_elapsed, 1),
+        "completed": completed,
+        "in_slo": in_slo,
+        "goodput_qps": round(in_slo / plan.duration_s, 1),
+        "sheds": sheds,
+        "overloads": overloads,
+        "timeouts": timeouts,
+        "failed": failed,
+        "accounting_ok": (completed + sheds + overloads + timeouts
+                          + failed) == offered,
+        "p50_ms": round(lat[len(lat) // 2], 3) if lat else None,
+        "p99_ms": (round(lat[min(len(lat) - 1, int(0.99 * len(lat)))], 3)
+                   if lat else None),
+    }
+
+
+def overload_series(run_secs: float = 1.5,
+                    mults=(1.0, 2.0, 4.0),
+                    replicas: int = 2, slo_ms: float = 50.0,
+                    hedge_ms: float = 25.0, shed_watermark: int = 256,
+                    users: int = 1_000_000,
+                    artifact_dir: "str | None" = None,
+                    saturation_qps: "float | None" = None,
+                    population=None, seed: int = 0) -> dict:
+    """The overload plane under open-loop Zipf flood: goodput (in-SLO
+    completions/s), p50/p99, and shed/overload/hedge counts at multiples
+    of the MEASURED saturation QPS, with the zero-silent-drop accounting
+    identity asserted per point. Each point gets a fresh fleet (admission
+    gate + hedging armed) so its counters and queue state are clean; the
+    user population is shared across points, so head users carry history
+    continuity through the whole sweep.
+
+    Honesty fields: ``load_kind`` labels the traffic as an open-loop
+    synthetic Zipf flood (``users`` synthetic users, NOT a production
+    trace); ``saturation_qps`` is measured on THIS host immediately before
+    the sweep, so the multiples survive host-speed changes;
+    ``host_cpu_count`` is what any scaling reading must be judged against
+    (the driver, hedger, and both replicas time-slice the same cores)."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from deepfm_tpu.loop.traffic import FloodTrafficPlan, ZipfUserPopulation
+    from deepfm_tpu.serve import ReplicatedEngine
+    from deepfm_tpu.utils import export as export_lib
+
+    cfg = _bench_cfg()
+    tmp = artifact_dir or export_serving_artifacts(
+        tempfile.mkdtemp(prefix="bench_flood_"))
+    orig_tf = export_lib._export_tf_savedmodel
+    export_lib._export_tf_savedmodel = lambda *a, **k: None  # not served
+    try:
+        export_lib.write_latest(tmp, "1")
+        if saturation_qps is None:
+            saturation_qps = serving_saturation_qps(
+                tmp, replicas=replicas, probe_secs=max(1.0, run_secs))
+        pop = population if population is not None else ZipfUserPopulation(
+            seed, users=users)
+        points = []
+        for i, mult in enumerate(mults):
+            plan = FloodTrafficPlan(
+                seed + 100 + i, offered_qps=mult * saturation_qps,
+                duration_s=run_secs, population=pop,
+                field_size=cfg.field_size, feature_size=cfg.feature_size)
+            engine = ReplicatedEngine.serve_latest(
+                tmp, replicas=replicas, hedge_ms=hedge_ms,
+                hedge_poll_secs=0.02,
+                admission_kw={"slo_ms": slo_ms,
+                              "shed_watermark": shed_watermark},
+                **_FLOOD_ENGINE_KW)
+            try:
+                point = overload_point(
+                    engine, plan, slo_ms=slo_ms,
+                    resolve_timeout_s=max(10.0, 4.0 * run_secs))
+                s = engine.summary()
+            finally:
+                engine.close()
+            point.update({
+                "offered_mult": mult,
+                "hedges_fired": s["hedges_fired"],
+                "hedges_won": s["hedges_won"],
+                "hedges_cancelled": s["hedges_cancelled"],
+                "sheds_by_class": s["serving_sheds_by_class"],
+                "admission_transitions": s["admission_transitions"],
+            })
+            points.append(point)
+    finally:
+        export_lib._export_tf_savedmodel = orig_tf
+        if artifact_dir is None:
+            shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "saturation_qps": round(float(saturation_qps), 1),
+        "replicas": replicas,
+        "serve_slo_ms": slo_ms,
+        "serve_hedge_ms": hedge_ms,
+        "serve_shed_watermark": shed_watermark,
+        "users": pop.users,
+        "zipf_q": pop.zipf_q,
+        "touched_users": pop.touched_users,
+        "points": points,
+        "load_kind": "synthetic-open-loop-zipf-flood",
+        "device_kind": jax.devices()[0].device_kind,
+        "host_cpu_count": os.cpu_count(),
+    }
+
+
 def multitask_series() -> dict:
     """Multi-task head comparison: per-task AUC + train ex/s for a
     single-task baseline vs shared_bottom vs MMoE over the SAME data,
@@ -1125,6 +1349,12 @@ def main() -> None:
         serving = {"error": str(e)}
 
     try:
+        overload = overload_series()
+    except Exception as e:
+        print(f"bench: overload series error: {e}", file=sys.stderr)
+        overload = {"error": str(e)}
+
+    try:
         multitask = multitask_series()
     except Exception as e:
         print(f"bench: multitask series error: {e}", file=sys.stderr)
@@ -1186,6 +1416,7 @@ def main() -> None:
         "device_resident": device_resident,
         "online_publish": online_publish,
         "serving": serving,
+        "overload": overload,
         "multitask": multitask,
         "cascade": cascade,
         "production_day": production_day,

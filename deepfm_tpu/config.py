@@ -235,6 +235,25 @@ class Config:
     # bypass into every forming batch (never stranded behind a max-batch
     # fill of large requests). 0 disables the lane.
     serve_small_rows: int = 0
+    # ---- overload plane (serve/admission.py; README "Overload &
+    # degradation", TUNING §2.18) ----
+    # Per-request latency SLO: the admission gate sheds low-value classes
+    # when the EWMA queue delay crosses half this budget. 0 disables the
+    # delay signal (depth-only gating if a watermark is set).
+    serve_slo_ms: float = 0.0
+    # Queue-depth shed watermark in rows (pressure 1.0). 0 = half the
+    # resolved serve_queue_rows. Either serve_slo_ms or
+    # serve_shed_watermark > 0 arms the admission controller.
+    serve_shed_watermark: int = 0
+    # Request hedging floor (ReplicatedEngine): a request still pending
+    # after max(this, fleet p99) ms is re-submitted to the least-loaded
+    # other replica; first completion wins, the loser is cancelled and
+    # counted. 0 disables hedging.
+    serve_hedge_ms: float = 0.0
+    # Degraded-mode candidate count (CascadeEngine): under pressure the
+    # cascade first shrinks retrieve_k to this, then skips the ranker and
+    # serves retrieval order. 0 disables the degradation ladder.
+    degrade_retrieve_k: int = 0
 
     # ---- mesh / parallelism (replaces TF_CONFIG + horovod knobs) ----
     mesh_data: int = 0                # data-parallel axis size (0 = all devices)
@@ -472,6 +491,16 @@ class Config:
                 "serve_small_rows must be in 0..serve_max_batch "
                 f"(got {self.serve_small_rows} vs "
                 f"serve_max_batch={self.serve_max_batch})")
+        if self.serve_slo_ms < 0:
+            raise ValueError("serve_slo_ms must be >= 0 (0 disables)")
+        if self.serve_shed_watermark < 0:
+            raise ValueError(
+                "serve_shed_watermark must be >= 0 (0 = half the queue)")
+        if self.serve_hedge_ms < 0:
+            raise ValueError("serve_hedge_ms must be >= 0 (0 disables)")
+        if self.degrade_retrieve_k < 0:
+            raise ValueError(
+                "degrade_retrieve_k must be >= 0 (0 disables the ladder)")
         bucket_sizes = self.serve_bucket_sizes
         if any(b < 1 for b in bucket_sizes):
             raise ValueError(

@@ -91,3 +91,156 @@ class DiurnalTrafficPlan:
         fingerprints."""
         return tuple((r.t_s, r.first_id, int(r.ids.shape[0]),
                       r.labels.tobytes()) for r in self.requests)
+
+
+# --------------------------------------------------------------------------
+# Flood traffic: a million-user Zipf population driven past saturation.
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class FloodRequest:
+    t_s: float            # scheduled submit time, seconds from plan start
+    user_id: int          # population index (0 = most active); the sticky
+    #                       affinity key and history owner
+    value: str            # admission value class (serve/admission.py)
+    first_id: int         # impression id of row 0
+    ids: np.ndarray       # [n, F] int32
+    vals: np.ndarray      # [n, F] float32
+    hist_ids: np.ndarray  # [L] int32 — the user's click history BEFORE
+    #                       this request (per-user continuity)
+    hist_mask: np.ndarray  # [L] float32
+
+
+class ZipfUserPopulation:
+    """>= 1M synthetic users with Zipf-distributed activity and per-user
+    click-history continuity.
+
+    User activity follows ``rank^-zipf_q`` (user 0 is the hottest head
+    user); item popularity follows its own Zipf over ``item_vocab`` ids, so
+    DIN/BST and the twin-tower index see realistic skew: head users
+    accumulate long histories across requests, tail users mostly arrive
+    cold. Sampling is a vectorized inverse-CDF (``searchsorted`` over a
+    precomputed float64 cumsum — ~8 MB per million users, built once);
+    histories are LAZY per-user deques so a million-user population costs
+    memory only for the users traffic actually touched.
+    """
+
+    def __init__(self, seed: int, *, users: int = 1_000_000,
+                 zipf_q: float = 1.1, item_vocab: int = 10_000,
+                 item_zipf_q: float = 1.05, hist_len: int = 8):
+        if users < 1 or item_vocab < 1:
+            raise ValueError(
+                f"need users/item_vocab >= 1, got {users}/{item_vocab}")
+        self.seed = int(seed)
+        self.users = int(users)
+        self.zipf_q = float(zipf_q)
+        self.item_vocab = int(item_vocab)
+        self.hist_len = int(hist_len)
+        w = np.arange(1, self.users + 1, dtype=np.float64) ** -zipf_q
+        self._user_cum = np.cumsum(w)
+        self._user_cum /= self._user_cum[-1]
+        wi = np.arange(1, self.item_vocab + 1,
+                       dtype=np.float64) ** -float(item_zipf_q)
+        self._item_cum = np.cumsum(wi)
+        self._item_cum /= self._item_cum[-1]
+        self._hist: dict = {}     # user_id -> List[int], most recent last
+
+    def sample_users(self, rng: np.random.Generator,
+                     count: int) -> np.ndarray:
+        """``count`` user ids by inverse CDF (0 = most active)."""
+        return np.searchsorted(self._user_cum, rng.random(count),
+                               side="right").astype(np.int64)
+
+    def sample_items(self, rng: np.random.Generator,
+                     count: int) -> np.ndarray:
+        return np.searchsorted(self._item_cum, rng.random(count),
+                               side="right").astype(np.int64)
+
+    @property
+    def touched_users(self) -> int:
+        """How many distinct users have any history (lazy-store size)."""
+        return len(self._hist)
+
+    def history(self, user_id: int) -> Tuple[np.ndarray, np.ndarray]:
+        """(hist_ids [L], hist_mask [L]) — most recent clicks, zero-padded
+        at the tail like the cascade's ``_fit_history`` convention."""
+        clicks = self._hist.get(int(user_id), ())
+        out_ids = np.zeros((self.hist_len,), np.int32)
+        out_mask = np.zeros((self.hist_len,), np.float32)
+        n = min(len(clicks), self.hist_len)
+        if n:
+            out_ids[:n] = clicks[-n:]
+            out_mask[:n] = 1.0
+        return out_ids, out_mask
+
+    def click(self, user_id: int, item_id: int) -> None:
+        """Append one click to the user's history (bounded at hist_len)."""
+        hist = self._hist.setdefault(int(user_id), [])
+        hist.append(int(item_id))
+        if len(hist) > self.hist_len:
+            del hist[:len(hist) - self.hist_len]
+
+
+class FloodTrafficPlan:
+    """Open-loop flood schedule: a FIXED offered rate (Poisson arrivals at
+    ``offered_qps``), each request drawn from a shared
+    :class:`ZipfUserPopulation` with a seeded value class — the load shape
+    for driving a fleet PAST saturation, where a closed-loop driver would
+    self-throttle and hide the knee.
+
+    The population is shared (and mutated: every planned request appends
+    its item to the user's history), so sweeping multiple plans over one
+    population carries history continuity across offered-load points.
+    Construction order is the determinism contract: building the same
+    plans in the same order from a fresh same-seed population reproduces
+    identical traffic (``fingerprint_data``).
+    """
+
+    #: seeded value-class mix (lowest value first; must sum to 1)
+    VALUE_MIX: Tuple[Tuple[str, float], ...] = (
+        ("bulk", 0.3), ("normal", 0.6), ("critical", 0.1))
+
+    def __init__(self, seed: int, *, offered_qps: float, duration_s: float,
+                 population: ZipfUserPopulation,
+                 field_size: int, feature_size: int, max_rows: int = 1):
+        if offered_qps <= 0 or duration_s <= 0:
+            raise ValueError(
+                f"need positive offered_qps/duration_s, got "
+                f"{offered_qps}/{duration_s}")
+        self.seed = int(seed)
+        self.offered_qps = float(offered_qps)
+        self.duration_s = float(duration_s)
+        self.population = population
+        rng = np.random.default_rng(self.seed)
+        classes = [c for c, _ in self.VALUE_MIX]
+        probs = np.asarray([p for _, p in self.VALUE_MIX])
+        requests: List[FloodRequest] = []
+        t, next_id = 0.0, 0
+        while True:
+            t += float(rng.exponential(1.0 / self.offered_qps))
+            if t >= self.duration_s:
+                break
+            user = int(population.sample_users(rng, 1)[0])
+            value = classes[int(rng.choice(len(classes), p=probs))]
+            item = int(population.sample_items(rng, 1)[0]) \
+                % max(1, feature_size)
+            n = int(rng.integers(1, max_rows + 1)) if max_rows > 1 else 1
+            ids = rng.integers(0, feature_size,
+                               (n, field_size)).astype(np.int32)
+            ids[:, 0] = item
+            vals = rng.normal(size=(n, field_size)).astype(np.float32)
+            hist_ids, hist_mask = population.history(user)
+            requests.append(FloodRequest(
+                t_s=round(t, 6), user_id=user, value=value,
+                first_id=next_id, ids=ids, vals=vals,
+                hist_ids=hist_ids, hist_mask=hist_mask))
+            population.click(user, item)
+            next_id += n
+        self.requests: Tuple[FloodRequest, ...] = tuple(requests)
+        self.total_rows = next_id
+
+    def fingerprint_data(self) -> Tuple:
+        """Deterministic digestable view for audit fingerprints."""
+        return tuple(
+            (r.t_s, r.user_id, r.value, r.first_id, r.ids.tobytes(),
+             r.hist_ids.tobytes()) for r in self.requests)

@@ -32,6 +32,8 @@ import numpy as np
 from ..config import Config
 from ..data import fileio
 from ..models.twin_tower import TwinTower
+from ..serve.admission import (DEGRADE_RUNGS, VALUE_DEFAULT,
+                               AdmissionController, DegradationLadder)
 from ..serve.engine import ServingEngine
 from ..serve.stats import ServingStats
 from ..utils import export as export_lib
@@ -154,6 +156,17 @@ class CascadeEngine:
     index then returns ITS notion of head items) and the ranker's attention
     contributes exact zeros — finite probabilities, never NaN (the
     masked-softmax regression the drill pins).
+
+    **Overload plane.** ``slo_ms``/``shed_watermark`` build an
+    :class:`~deepfm_tpu.serve.admission.AdmissionController` for the inner
+    ranking engine (low-value requests get a typed ``AdmissionShed``).
+    ``degrade_retrieve_k`` > 0 additionally arms the graceful-degradation
+    ladder: under pressure ``recommend`` first shrinks the candidate set to
+    ``degrade_retrieve_k`` (rung ``reduced_retrieve``), then skips the
+    ranker entirely and answers in retrieval order (rung
+    ``retrieval_only`` — scores are the index's inner-product scores, NOT
+    calibrated probabilities). Every rung change is a counted, span-traced
+    transition; per-request degradation is counted per rung.
     """
 
     def __init__(self, publish_dir: str, *, retrieve_k: int = 50,
@@ -161,11 +174,18 @@ class CascadeEngine:
                  max_delay_ms: float = 5.0,
                  buckets: Optional[Sequence[int]] = None,
                  queue_rows: int = 0,
+                 slo_ms: float = 0.0, shed_watermark: int = 0,
+                 degrade_retrieve_k: int = 0,
                  watcher_kw: Optional[dict] = None,
                  engine_kw: Optional[dict] = None):
         if retrieve_k < 1:
             raise ValueError("retrieve_k must be >= 1")
+        if degrade_retrieve_k < 0 or degrade_retrieve_k > retrieve_k:
+            raise ValueError(
+                f"degrade_retrieve_k must be in 0..retrieve_k="
+                f"{retrieve_k}, got {degrade_retrieve_k}")
         self.retrieve_k = int(retrieve_k)
+        self.degrade_retrieve_k = int(degrade_retrieve_k)
         resolved = tuple(buckets) if buckets is not None \
             else export_lib.serving_buckets(max_batch)
         stats = ServingStats()
@@ -176,10 +196,24 @@ class CascadeEngine:
             loader=lambda path: CascadeModel(path, buckets=resolved),
             on_swap=lambda path: stats.record_swap(),
             **wkw)
+        ekw = dict(engine_kw or {})
+        if (slo_ms > 0 or shed_watermark > 0) \
+                and "admission" not in ekw and "admission_kw" not in ekw:
+            ekw["admission_kw"] = {"slo_ms": slo_ms,
+                                   "shed_watermark": shed_watermark}
         self._engine = ServingEngine(
             self._watcher, max_batch=max_batch, max_delay_ms=max_delay_ms,
-            buckets=resolved, queue_rows=queue_rows, stats=stats,
-            **(engine_kw or {}))
+            buckets=resolved, queue_rows=queue_rows, stats=stats, **ekw)
+        self._ladder: Optional[DegradationLadder] = None
+        if self.degrade_retrieve_k > 0:
+            self._ladder = DegradationLadder(stats=stats)
+            # Without an admission gate the ladder still needs a pressure
+            # scale: the same watermark default (half the queue).
+            self._degrade_watermark = (
+                self._engine.admission.shed_watermark
+                if self._engine.admission is not None
+                else max(1, int(shed_watermark)
+                         or self._engine.queue_rows // 2))
 
     @property
     def watcher(self) -> export_lib.LatestWatcher:
@@ -199,6 +233,30 @@ class CascadeEngine:
             raise RuntimeError("no cascade artifact published yet")
         return model
 
+    # ----------------------------------------------------- degraded modes
+    @property
+    def ladder(self) -> Optional[DegradationLadder]:
+        return self._ladder
+
+    def _pressure(self) -> float:
+        """The ladder's drive signal: the admission controller's combined
+        depth+delay pressure when one is armed, raw queue depth over the
+        degrade watermark otherwise."""
+        pending = self._engine.pending_rows
+        adm = self._engine.admission
+        if adm is not None:
+            return adm.pressure(pending)
+        return pending / self._degrade_watermark
+
+    def ladder_rung(self) -> int:
+        """Advance the degradation ladder against CURRENT pressure and
+        return the rung (0 = full cascade). Called per recommend(); also
+        callable idle (the drill uses it to observe recovery after a
+        chaos window drains)."""
+        if self._ladder is None:
+            return 0
+        return self._ladder.update(self._pressure())
+
     # ------------------------------------------------------------- serving
     def retrieve(self, hist_ids: np.ndarray, hist_mask: np.ndarray,
                  k: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
@@ -211,14 +269,22 @@ class CascadeEngine:
 
     def recommend(self, hist_ids: np.ndarray, hist_mask: np.ndarray,
                   feat_ids: np.ndarray, feat_vals: np.ndarray, *,
-                  k: int = 10, timeout: Optional[float] = 30.0
+                  k: int = 10, timeout: Optional[float] = 30.0,
+                  value: str = VALUE_DEFAULT
                   ) -> Tuple[np.ndarray, np.ndarray]:
         """ONE user's end-to-end recommendation: (item_ids [k], probs [k]).
 
         ``hist_ids``/``hist_mask`` [L]; ``feat_ids``/``feat_vals`` [F] the
         request context (field ``ITEM_SLOT`` is overwritten per candidate).
         The SAME loaded model version serves both stages of this request
-        even if a hot swap lands mid-flight.
+        even if a hot swap lands mid-flight. ``value`` is the admission
+        value class of the inner ranking request.
+
+        With the degradation ladder armed, an over-budget fleet answers
+        degraded instead of failing: rung 1 ranks only
+        ``degrade_retrieve_k`` candidates; rung 2 skips the ranker and the
+        returned scores are RETRIEVAL scores (inner products), not
+        probabilities — callers can tell from the counted, traced rung.
         """
         model = self.current()
         hist_ids = np.asarray(hist_ids, np.int32).reshape(1, -1)
@@ -229,10 +295,20 @@ class CascadeEngine:
             raise ValueError(
                 f"expected {model.field_size} context fields, "
                 f"got {feat_ids.shape[0]}")
+        rung = self.ladder_rung()
+        retrieve_k = self.retrieve_k if rung == 0 \
+            else self.degrade_retrieve_k
         users = model.user_embed(hist_ids, hist_mask)
-        cand_ids, _ = model.index.search(users, self.retrieve_k)
+        cand_ids, cand_scores = model.index.search(users, retrieve_k)
         cand_ids = cand_ids[0]                              # [N]
         n = cand_ids.shape[0]
+        if rung > 0:
+            self.stats.record_degraded(DEGRADE_RUNGS[rung])
+        if rung >= 2:
+            # retrieval_only: serve the index's order — the request costs
+            # one tower embed + one ANN search, no ranking flush at all.
+            k = min(int(k), n)
+            return cand_ids[:k], cand_scores[0][:k]
         ids = np.tile(feat_ids, (n, 1)).astype(np.int32)    # [N, F]
         vals = np.tile(feat_vals, (n, 1)).astype(np.float32)
         ids[:, ITEM_SLOT] = cand_ids
@@ -244,7 +320,8 @@ class CascadeEngine:
             vals = np.concatenate(
                 [vals, np.tile(h_mask, (n, 1))], axis=1)
         probs = np.asarray(
-            self._engine.predict(ids, vals, timeout=timeout)).reshape(-1)
+            self._engine.predict(ids, vals, timeout=timeout,
+                                 value=value)).reshape(-1)
         k = min(int(k), n)
         top = np.argsort(-probs, kind="stable")[:k]
         return cand_ids[top], probs[top]

@@ -4,6 +4,9 @@ Layering (heaviest import last — clients can use :mod:`.frontend` and
 :mod:`.stats` without pulling jax):
 
   * :mod:`.stats` — thread-safe latency/QPS/occupancy/swap accounting.
+  * :mod:`.admission` — SLO-aware admission gate (value classes, hysteresis
+    shed ladder, typed ``AdmissionShed``) and the cascade's degradation
+    ladder; jax-free.
   * :mod:`.engine` — bounded queue, dynamic batcher, bucketed predict,
     response demux, hot swap via ``utils.export.LatestWatcher`` (the jax
     import happens lazily at engine construction).
@@ -14,21 +17,31 @@ Layering (heaviest import last — clients can use :mod:`.frontend` and
     ``data.shm_ring`` slab rings, with the exit-43 wedge contract.
 """
 
-from .engine import ServeFuture, ServerOverloaded, ServingEngine
+from .admission import (VALUE_CLASSES, VALUE_DEFAULT, AdmissionController,
+                        AdmissionShed, DegradationLadder, HysteresisLadder)
+from .engine import ServeFuture, ServeTimeout, ServerOverloaded, ServingEngine
 from .frontend import (FrontendHandle, FrontendServer, ServingClient,
                        client_main)
-from .replicas import ReplicatedEngine
+from .replicas import HedgedFuture, ReplicatedEngine
 from .stats import ServingStats, aggregate_summary
 
 __all__ = [
+    "AdmissionController",
+    "AdmissionShed",
+    "DegradationLadder",
     "FrontendHandle",
     "FrontendServer",
+    "HedgedFuture",
+    "HysteresisLadder",
     "ReplicatedEngine",
     "ServeFuture",
+    "ServeTimeout",
     "ServerOverloaded",
     "ServingClient",
     "ServingEngine",
     "ServingStats",
+    "VALUE_CLASSES",
+    "VALUE_DEFAULT",
     "aggregate_summary",
     "client_main",
 ]

@@ -55,6 +55,8 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..obs import trace as trace_lib
+from ..utils import faults as faults_lib
+from .admission import VALUE_DEFAULT, AdmissionController
 from .stats import LANE_LARGE, LANE_SMALL, ServingStats
 
 
@@ -63,21 +65,42 @@ class ServerOverloaded(RuntimeError):
 
     The typed backpressure signal: callers retry with backoff or shed load;
     the engine never blocks a submitter and never silently drops a request.
+    (A policy refusal of a low-value class under pressure is the distinct
+    :class:`~deepfm_tpu.serve.admission.AdmissionShed`.)
+    """
+
+
+class ServeTimeout(TimeoutError):
+    """A future did not resolve within the caller's budget.
+
+    Typed so frontends can forward it over the wire distinctly from a
+    predict failure: the request may STILL complete server-side (the engine
+    never abandons an admitted request) — only this caller stopped waiting.
     """
 
 
 class ServeFuture:
-    """One request's pending result: resolved by the batcher's demux."""
+    """One request's pending result: resolved by the batcher's demux.
 
-    __slots__ = ("ids", "vals", "n", "lane", "t_enqueue", "latency_ms",
-                 "trace_id", "model_version", "_event", "_probs", "_error")
+    Resolution is first-wins and idempotent: under request hedging two
+    engine legs may race to resolve the caller-visible result, and a
+    cancelled loser that was already mid-flush resolves harmlessly (the
+    canceller ignores it). ``add_done_callback`` fires exactly once, after
+    the winning resolution, outside the future's lock.
+    """
+
+    __slots__ = ("ids", "vals", "n", "lane", "value", "t_enqueue",
+                 "latency_ms", "trace_id", "model_version", "_event",
+                 "_probs", "_error", "_lock", "_callbacks", "_cancelled")
 
     def __init__(self, ids: np.ndarray, vals: np.ndarray, t_enqueue: float,
-                 lane: str = LANE_LARGE, trace_id: Optional[int] = None):
+                 lane: str = LANE_LARGE, trace_id: Optional[int] = None,
+                 value: str = VALUE_DEFAULT):
         self.ids = ids
         self.vals = vals
         self.n = int(ids.shape[0])
         self.lane = lane
+        self.value = value                  # admission value class
         self.t_enqueue = t_enqueue
         self.latency_ms: Optional[float] = None
         self.trace_id = trace_id            # correlation id (obs.trace)
@@ -85,26 +108,70 @@ class ServeFuture:
         self._event = threading.Event()
         self._probs: Optional[np.ndarray] = None
         self._error: Optional[BaseException] = None
+        self._lock = threading.Lock()
+        self._callbacks: List[Callable[["ServeFuture"], None]] = []
+        self._cancelled = False
 
     def done(self) -> bool:
         return self._event.is_set()
 
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+    def cancel(self) -> bool:
+        """Best-effort: a cancelled future still waiting in the queue is
+        dropped at batch formation (never executed); one already in a
+        flush resolves normally and the canceller ignores the result.
+        Returns False if the future had already resolved."""
+        self._cancelled = True
+        return not self._event.is_set()
+
+    def add_done_callback(self,
+                          fn: Callable[["ServeFuture"], None]) -> None:
+        """Run ``fn(self)`` once the future resolves (immediately if it
+        already has). Callbacks run on the resolving thread, outside the
+        future's lock — keep them cheap and non-blocking."""
+        with self._lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
+
+    def _resolve(self) -> Optional[list]:
+        """Under ``_lock``: claim the resolution; None if already done."""
+        if self._event.is_set():
+            return None
+        cbs, self._callbacks = self._callbacks, []
+        return cbs
+
     def set_result(self, probs: np.ndarray, latency_ms: float) -> None:
-        self._probs = probs
-        self.latency_ms = latency_ms
-        self._event.set()
+        with self._lock:
+            cbs = self._resolve()
+            if cbs is None:
+                return
+            self._probs = probs
+            self.latency_ms = latency_ms
+            self._event.set()
+        for cb in cbs:
+            cb(self)
 
     def set_error(self, exc: BaseException) -> None:
-        self._error = exc
-        self._event.set()
+        with self._lock:
+            cbs = self._resolve()
+            if cbs is None:
+                return
+            self._error = exc
+            self._event.set()
+        for cb in cbs:
+            cb(self)
 
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
         """Block for the probs — ``[n]`` for single-output models,
         ``{task_name: [n]}`` for multitask artifacts; raises the predict
-        error if the flush failed, TimeoutError if not resolved in
-        ``timeout``."""
+        error if the flush failed, typed :class:`ServeTimeout` if not
+        resolved in ``timeout``."""
         if not self._event.wait(timeout):
-            raise TimeoutError(
+            raise ServeTimeout(
                 f"request of {self.n} rows unresolved after {timeout}s")
         if self._error is not None:
             raise self._error
@@ -121,6 +188,8 @@ class ServingEngine:
                  buckets: Optional[Sequence[int]] = None,
                  inflight: int = 2, small_rows: int = 0,
                  stats: Optional[ServingStats] = None,
+                 admission: Optional[AdmissionController] = None,
+                 admission_kw: Optional[dict] = None,
                  clock: Callable[[], float] = time.monotonic,
                  start: bool = True):
         from ..utils import export as export_lib  # lazy: jax-heavy
@@ -159,6 +228,21 @@ class ServingEngine:
             serve_inflight=self.inflight,
             serve_small_rows=self.small_rows)
         self._clock = clock
+        # SLO-aware admission gate (optional). ``admission_kw`` builds a
+        # controller bound to THIS engine's queue/stats/clock — the form
+        # replica constructors use, so each replica gets its own gate
+        # (pressure is per-queue; sharing one would gate on stale state).
+        if admission is None and admission_kw:
+            admission = AdmissionController(
+                queue_rows=self.queue_rows, stats=self.stats, clock=clock,
+                **admission_kw)
+        self._admission = admission
+        if admission is not None:
+            if admission.stats is None:
+                admission.stats = self.stats
+            self.stats.set_policy(
+                serve_shed_watermark=admission.shed_watermark,
+                serve_slo_ms=admission.slo_ms)
         self._cond = threading.Condition()
         self._queue: deque = deque()        # large lane (FIFO)
         self._small: deque = deque()        # priority lane (FIFO, pops first)
@@ -194,6 +278,10 @@ class ServingEngine:
         kw.setdefault("queue_rows", cfg.serve_queue_rows)
         kw.setdefault("inflight", cfg.serve_inflight)
         kw.setdefault("small_rows", cfg.serve_small_rows)
+        if cfg.serve_slo_ms > 0 or cfg.serve_shed_watermark > 0:
+            kw.setdefault("admission_kw", {
+                "slo_ms": cfg.serve_slo_ms,
+                "shed_watermark": cfg.serve_shed_watermark})
         bucket_list = cfg.serve_bucket_sizes
         if bucket_list:
             kw.setdefault("buckets", bucket_list)
@@ -253,16 +341,25 @@ class ServingEngine:
     def watcher(self):
         return self._watcher
 
+    @property
+    def admission(self) -> Optional[AdmissionController]:
+        return self._admission
+
     # ------------------------------------------------------------- client
     def submit(self, feat_ids: np.ndarray, feat_vals: np.ndarray,
-               trace_id: Optional[int] = None) -> ServeFuture:
+               trace_id: Optional[int] = None,
+               value: str = VALUE_DEFAULT) -> ServeFuture:
         """Enqueue one request ``(ids[n,F], vals[n,F])``; returns its
         future. Requests of at most ``small_rows`` rows enter the priority
         lane. ``trace_id`` (see ``obs.trace.new_trace_id``) rides the
         future and is stamped into the flush's trace span for
-        request→model-version correlation. Raises
-        :class:`ServerOverloaded` when the queue is full or the engine is
-        shutting down, ValueError on malformed shapes."""
+        request→model-version correlation. ``value`` is the admission
+        value class (lowest shed first under pressure; ignored without an
+        admission controller). Raises
+        :class:`~deepfm_tpu.serve.admission.AdmissionShed` when the gate
+        refuses the class, :class:`ServerOverloaded` when the queue is
+        full or the engine is shutting down, ValueError on malformed
+        shapes."""
         ids = np.asarray(feat_ids)
         vals = np.asarray(feat_vals)
         if ids.ndim != 2 or vals.shape != ids.shape:
@@ -277,11 +374,16 @@ class ServingEngine:
         small = 0 < n <= self.small_rows
         fut = ServeFuture(ids, vals, self._clock(),
                           lane=LANE_SMALL if small else LANE_LARGE,
-                          trace_id=trace_id)
+                          trace_id=trace_id, value=value)
         with self._cond:
             if self._closing:
                 self.stats.record_overload()
                 raise ServerOverloaded("serving engine is shut down")
+            if self._admission is not None:
+                # Value-aware gate BEFORE the queue-full wall: under
+                # pressure low classes get a typed AdmissionShed while the
+                # queue still has room for high-value work.
+                self._admission.admit(value, self._queued_rows)
             if self._queued_rows + n > self.queue_rows:
                 self.stats.record_overload()
                 raise ServerOverloaded(
@@ -294,10 +396,11 @@ class ServingEngine:
 
     def predict(self, feat_ids: np.ndarray, feat_vals: np.ndarray,
                 timeout: Optional[float] = None,
-                trace_id: Optional[int] = None) -> np.ndarray:
+                trace_id: Optional[int] = None,
+                value: str = VALUE_DEFAULT) -> np.ndarray:
         """Synchronous convenience: ``submit().result()``."""
-        return self.submit(feat_ids, feat_vals, trace_id=trace_id) \
-            .result(timeout)
+        return self.submit(feat_ids, feat_vals, trace_id=trace_id,
+                           value=value).result(timeout)
 
     # ------------------------------------------------------------ batcher
     def start(self) -> "ServingEngine":
@@ -360,33 +463,54 @@ class ServingEngine:
         the in-flight window, never a whole queue of large rows.
         """
         with self._cond:
-            while not (self._queue or self._small) and not self._closing:
-                self._cond.wait()
-            if not (self._queue or self._small):
-                return [], 0
-            if not self._closing and self.max_delay_s > 0:
-                # Deadline anchored at the FIRST queued request (either
-                # lane): a single request waits at most max_delay_ms. A
-                # full max_batch of rows arriving earlier preempts it.
-                deadline = self._head_enqueue_time() + self.max_delay_s
-                while self._queued_rows < self.max_batch \
-                        and not self._closing:
-                    remaining = deadline - self._clock()
-                    if remaining <= 0:
-                        break
-                    self._cond.wait(timeout=remaining)
-            batch: List[ServeFuture] = []
-            rows = 0
-            while self._small and rows + self._small[0].n <= self.max_batch:
-                fut = self._small.popleft()
-                rows += fut.n
-                batch.append(fut)
-            while self._queue and rows + self._queue[0].n <= self.max_batch:
-                fut = self._queue.popleft()
-                rows += fut.n
-                batch.append(fut)
-            self._queued_rows -= rows
-            return batch, rows
+            while True:
+                while not (self._queue or self._small) and not self._closing:
+                    self._cond.wait()
+                if not (self._queue or self._small):
+                    return [], 0
+                if not self._closing and self.max_delay_s > 0:
+                    # Deadline anchored at the FIRST queued request (either
+                    # lane): a single request waits at most max_delay_ms. A
+                    # full max_batch of rows arriving earlier preempts it.
+                    deadline = self._head_enqueue_time() + self.max_delay_s
+                    while self._queued_rows < self.max_batch \
+                            and not self._closing:
+                        remaining = deadline - self._clock()
+                        if remaining <= 0:
+                            break
+                        self._cond.wait(timeout=remaining)
+                batch: List[ServeFuture] = []
+                rows = 0
+                dropped = 0     # cancelled rows popped but never flushed
+                while self._small \
+                        and rows + self._small[0].n <= self.max_batch:
+                    fut = self._small.popleft()
+                    if fut.cancelled():
+                        dropped += fut.n
+                        continue
+                    rows += fut.n
+                    batch.append(fut)
+                while self._queue \
+                        and rows + self._queue[0].n <= self.max_batch:
+                    fut = self._queue.popleft()
+                    if fut.cancelled():
+                        dropped += fut.n
+                        continue
+                    rows += fut.n
+                    batch.append(fut)
+                self._queued_rows -= rows + dropped
+                if not batch:
+                    # Everything popped was a cancelled hedge loser — this
+                    # is NOT the drained-shutdown signal; re-wait.
+                    continue
+                if self._admission is not None:
+                    # Queue-delay signal: enqueue -> batch formation, the
+                    # part of the SLO the gate can still protect.
+                    now = self._clock()
+                    for fut in batch:
+                        self._admission.observe_delay(
+                            1000.0 * (now - fut.t_enqueue))
+                return batch, rows
 
     def _snapshot_fn(self) -> Tuple[Callable, Optional[int]]:
         """The predict fn to execute plus the model version it represents
@@ -434,6 +558,13 @@ class ServingEngine:
         if tids:
             sp.add(trace_ids=tids[:64])  # bounded per-event payload
         with sp:
+            # Chaos seam: an armed executor_slow fault (utils.faults) adds
+            # injected latency per flush — how the drill drives the
+            # degradation ladder without depending on host speed.
+            slow_s = faults_lib.executor_slow_delay()
+            if slow_s > 0:
+                trace_lib.instant("serve.executor_slow", delay_s=slow_s)
+                time.sleep(slow_s)
             try:
                 out = self._export.padded_predict(fn, ids, vals, self.buckets)
             except Exception as exc:  # noqa: BLE001 — forwarded per-request
@@ -449,21 +580,24 @@ class ServingEngine:
                 # {task: probs[n]}.
                 named = {k: np.asarray(v) for k, v in out.items()}
                 for fut in batch:
+                    # Record the latency computed HERE, not fut.latency_ms:
+                    # a future something else already resolved (a hedged
+                    # loser mid-flush) keeps its first-wins stamp and this
+                    # set_result is a no-op.
+                    lat = 1000.0 * (now - fut.t_enqueue)
                     fut.set_result(
                         {k: v[off:off + fut.n] for k, v in named.items()},
-                        latency_ms=1000.0 * (now - fut.t_enqueue))
+                        latency_ms=lat)
                     off += fut.n
-                    self.stats.record_request_done(fut.latency_ms,
-                                                   lane=fut.lane)
+                    self.stats.record_request_done(lat, lane=fut.lane)
             else:
                 # Single-output: the historical wire shape [n], bit-unchanged.
                 probs = np.asarray(out).reshape(-1)
                 for fut in batch:
-                    fut.set_result(probs[off:off + fut.n],
-                                   latency_ms=1000.0 * (now - fut.t_enqueue))
+                    lat = 1000.0 * (now - fut.t_enqueue)
+                    fut.set_result(probs[off:off + fut.n], latency_ms=lat)
                     off += fut.n
-                    self.stats.record_request_done(fut.latency_ms,
-                                                   lane=fut.lane)
+                    self.stats.record_request_done(lat, lane=fut.lane)
             self.stats.record_flush(rows, bucket,
                                     full=rows >= self.max_batch,
                                     version=version)

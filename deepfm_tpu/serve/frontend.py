@@ -46,7 +46,8 @@ import numpy as np
 
 from ..data import shm_ring
 from ..train.guard import StallWatchdog
-from .engine import ServerOverloaded, ServingEngine
+from .admission import AdmissionShed
+from .engine import ServeTimeout, ServerOverloaded, ServingEngine
 
 _MP_CTX = "spawn"   # same rationale as data/workers.py: no JAX state leaks
 _DEFAULT_CAPACITY = 4
@@ -154,6 +155,10 @@ class ServingClient:
                 err: Exception
                 if exc_type == "ServerOverloaded":
                     err = ServerOverloaded(detail)
+                elif exc_type == "AdmissionShed":
+                    err = AdmissionShed(detail)
+                elif exc_type == "ServeTimeout":
+                    err = ServeTimeout(detail)
                 elif exc_type == "ValueError":
                     err = ValueError(detail)
                 else:
@@ -227,10 +232,14 @@ class FrontendServer:
                  field_size: int, slab_records: Optional[int] = None,
                  capacity: int = _DEFAULT_CAPACITY, ctx: Any = None,
                  poll_secs: float = 0.005, timeout_s: float = 0.0,
+                 request_timeout_s: float = 0.0,
                  abort: Optional[Callable[[str], None]] = None,
                  client_alive: Optional[Callable[[int], bool]] = None):
         if num_clients < 1:
             raise ValueError("num_clients must be >= 1")
+        if request_timeout_s < 0:
+            raise ValueError(
+                f"request_timeout_s must be >= 0, got {request_timeout_s}")
         self._engine = engine
         # A replicated engine routes by client id (sticky affinity with
         # least-loaded spill); a single engine ignores the concept.
@@ -241,10 +250,16 @@ class FrontendServer:
                             else engine.max_batch)
         self._poll = float(poll_secs)
         self._timeout_s = float(timeout_s)
+        # Per-request response budget (0 = wait forever, the legacy
+        # behavior): a future pending past this is answered with a typed
+        # ServeTimeout error instead of wedging the client — derived from
+        # --serve_timeout_s by callers that pass a config.
+        self._request_timeout_s = float(request_timeout_s)
         self._abort = abort
         self._client_alive = client_alive
         self.responses_sent = 0
         self.errors_sent = 0
+        self.timeouts_sent = 0
         self.dropped_dead_client = 0
         ctx = ctx if ctx is not None else mp.get_context(_MP_CTX)
         req_spec = shm_ring.SlabSpec(self.max_rows, self.field_size)
@@ -321,7 +336,7 @@ class FrontendServer:
                     else:
                         fut = self._engine.submit(ids, vals,
                                                   trace_id=trace_id)
-                except (ServerOverloaded, ValueError) as e:
+                except (ServerOverloaded, AdmissionShed, ValueError) as e:
                     self._send_error(cid, req_id, e)
                     continue
                 self._inflight.append((fut, cid, req_id))
@@ -348,6 +363,21 @@ class FrontendServer:
         for _ in range(len(self._inflight)):
             fut, cid, req_id = self._inflight.popleft()
             if not fut.done():
+                if self._request_timeout_s > 0 and (
+                        time.monotonic() - fut.t_enqueue
+                        > self._request_timeout_s):
+                    # Budget blown: answer NOW with a typed timeout and
+                    # cancel the engine leg (dropped at batch formation if
+                    # still queued; a mid-flush resolution is ignored).
+                    cancel = getattr(fut, "cancel", None)
+                    if callable(cancel):
+                        cancel()
+                    self._send_error(cid, req_id, ServeTimeout(
+                        f"request of {getattr(fut, 'n', '?')} rows exceeded "
+                        f"the {self._request_timeout_s}s response budget"))
+                    self.timeouts_sent += 1
+                    progressed = True
+                    continue
                 self._inflight.append((fut, cid, req_id))
                 continue
             if not self._alive[cid] and self._client_gone(cid):

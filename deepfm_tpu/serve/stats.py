@@ -66,6 +66,18 @@ class ServingStats:
         self.max_batch_flushes = 0    # flushes that filled max_batch rows
         self.deadline_flushes = 0     # flushes fired by the delay deadline
         self.watcher_errors = 0       # LatestWatcher poll-loop exceptions
+        # Overload-plane accounting (admission/hedging/degradation). The
+        # reconciliation identity the flood harness asserts:
+        #   offered == completed + failed + overloads + sheds.
+        self.sheds = 0                # typed AdmissionShed rejections
+        self.sheds_by_class: Dict[str, int] = {}
+        self.admission_transitions = 0
+        self.admission_level = 0      # last shed level the gate entered
+        self.hedges_fired = 0         # hedge submitted to another replica
+        self.hedges_won = 0           # hedge resolved before the primary
+        self.hedges_cancelled = 0     # losing leg cancelled after a win
+        self.degraded_by_rung: Dict[str, int] = {}
+        self.degrade_transitions = 0
         self.latencies_ms: List[float] = []
         self.lane_latencies_ms: Dict[str, List[float]] = {
             LANE_SMALL: [], LANE_LARGE: []}
@@ -104,6 +116,43 @@ class ServingStats:
     def record_overload(self) -> None:
         with self._lock:
             self.overloads += 1
+
+    def record_shed(self, value_class: str) -> None:
+        """Admission gate refused one request's value class (typed
+        AdmissionShed — a policy refusal, not a full queue)."""
+        with self._lock:
+            self.sheds += 1
+            self.sheds_by_class[value_class] = \
+                self.sheds_by_class.get(value_class, 0) + 1
+
+    def record_admission_transition(self, level: int) -> None:
+        """The admission hysteresis ladder moved to ``level``."""
+        with self._lock:
+            self.admission_transitions += 1
+            self.admission_level = int(level)
+
+    def record_hedge_fired(self) -> None:
+        with self._lock:
+            self.hedges_fired += 1
+
+    def record_hedge_won(self) -> None:
+        with self._lock:
+            self.hedges_won += 1
+
+    def record_hedge_cancelled(self) -> None:
+        with self._lock:
+            self.hedges_cancelled += 1
+
+    def record_degraded(self, rung: str) -> None:
+        """One request answered at a degraded cascade rung (reduced
+        retrieve_k, or retrieval-only with the ranker skipped)."""
+        with self._lock:
+            self.degraded_by_rung[rung] = \
+                self.degraded_by_rung.get(rung, 0) + 1
+
+    def record_degrade_transition(self, rung: str) -> None:
+        with self._lock:
+            self.degrade_transitions += 1
 
     def record_flush(self, rows: int, bucket: int, *, full: bool = False,
                      version: Optional[int] = None) -> None:
@@ -185,6 +234,16 @@ class ServingStats:
                 "serving_max_batch_flushes": self.max_batch_flushes,
                 "serving_deadline_flushes": self.deadline_flushes,
                 "serving_watcher_errors": self.watcher_errors,
+                "serving_sheds": self.sheds,
+                "serving_sheds_by_class": dict(self.sheds_by_class),
+                "admission_level": self.admission_level,
+                "admission_transitions": self.admission_transitions,
+                "hedges_fired": self.hedges_fired,
+                "hedges_won": self.hedges_won,
+                "hedges_cancelled": self.hedges_cancelled,
+                "serving_degraded": sum(self.degraded_by_rung.values()),
+                "serving_degraded_by_rung": dict(self.degraded_by_rung),
+                "degrade_transitions": self.degrade_transitions,
                 "swap_blackout_ms": (
                     round(max(self.swap_blackouts_ms), 3)
                     if self.swap_blackouts_ms else None),
@@ -211,7 +270,12 @@ def aggregate_summary(stats: Sequence[ServingStats]) -> Dict[str, Any]:
     watcher_errs: List[int] = []
     totals = {"serving_requests": 0, "serving_failed": 0,
               "serving_overloads": 0, "serving_rows": 0,
-              "serving_flushes": 0, "serving_watcher_errors": 0}
+              "serving_flushes": 0, "serving_watcher_errors": 0,
+              "serving_sheds": 0, "hedges_fired": 0, "hedges_won": 0,
+              "hedges_cancelled": 0, "serving_degraded": 0,
+              "degrade_transitions": 0, "admission_transitions": 0}
+    sheds_by_class: Dict[str, int] = {}
+    degraded_by_rung: Dict[str, int] = {}
     first_done: Optional[float] = None
     last_done: Optional[float] = None
     real_rows = padded_rows = 0
@@ -228,6 +292,17 @@ def aggregate_summary(stats: Sequence[ServingStats]) -> Dict[str, Any]:
             totals["serving_rows"] += s.rows_completed
             totals["serving_flushes"] += s.flushes
             totals["serving_watcher_errors"] += s.watcher_errors
+            totals["serving_sheds"] += s.sheds
+            totals["hedges_fired"] += s.hedges_fired
+            totals["hedges_won"] += s.hedges_won
+            totals["hedges_cancelled"] += s.hedges_cancelled
+            totals["serving_degraded"] += sum(s.degraded_by_rung.values())
+            totals["degrade_transitions"] += s.degrade_transitions
+            totals["admission_transitions"] += s.admission_transitions
+            for cls, count in s.sheds_by_class.items():
+                sheds_by_class[cls] = sheds_by_class.get(cls, 0) + count
+            for rung, count in s.degraded_by_rung.items():
+                degraded_by_rung[rung] = degraded_by_rung.get(rung, 0) + count
             watcher_errs.append(s.watcher_errors)
             real_rows += s.real_rows
             padded_rows += s.padded_rows
@@ -258,6 +333,8 @@ def aggregate_summary(stats: Sequence[ServingStats]) -> Dict[str, Any]:
                                 if padded_rows else None),
         "swap_blackout_ms": (round(max(known_blackouts), 3)
                              if known_blackouts else None),
+        "serving_sheds_by_class": sheds_by_class,
+        "serving_degraded_by_rung": degraded_by_rung,
         "swap_blackout_ms_per_replica": [
             round(b, 3) if b is not None else None for b in blackout],
         # Per-replica fault visibility: an alive-but-failing watcher on ONE

@@ -152,6 +152,42 @@ def check_cold_fetch() -> None:
     raise InjectedFault("injected cold-store fetch failure")
 
 
+# Serving-executor latency seam (serve/engine.py): arm the next ``calls``
+# flushes to each sleep ``delay_s`` before predict. Count-based (not
+# wall-clock) so the overload drill's "slow period" ends after a DETERMINED
+# amount of work regardless of host speed — the recovery half of the
+# degradation-ladder assertion cannot be starved by a slow machine.
+
+_exec_slow_lock = threading.Lock()
+_exec_slow_delay_s: float = 0.0
+_exec_slow_calls: int = 0
+
+
+def set_executor_slow(delay_s: float, calls: int) -> None:
+    """Arm the next ``calls`` serving flushes to sleep ``delay_s`` each
+    (0 calls disarms)."""
+    global _exec_slow_delay_s, _exec_slow_calls
+    with _exec_slow_lock:
+        _exec_slow_delay_s = float(delay_s)
+        _exec_slow_calls = int(calls)
+
+
+def executor_slow_delay() -> float:
+    """Consume one armed slow flush; returns the delay to sleep (0 when
+    disarmed). Called by the engine's executor at every flush."""
+    global _exec_slow_calls
+    with _exec_slow_lock:
+        if _exec_slow_calls <= 0:
+            return 0.0
+        _exec_slow_calls -= 1
+        return _exec_slow_delay_s
+
+
+def executor_slow_remaining() -> int:
+    with _exec_slow_lock:
+        return _exec_slow_calls
+
+
 # Env seams for subprocess drills (scripts/online_drill.py,
 # scripts/production_drill.py): the train task calls install_env_faults()
 # at startup. Two ways in, one mechanism (docs/TUNING.md has the full seam
@@ -404,7 +440,9 @@ class ChaosSchedule:
     PROCESS_KINDS = ("read_faults", "publish_crash", "cold_fetch",
                      "nan_batches", "preempt_after_steps",
                      "fault_after_steps", "hold_after_steps")
-    DRIVER_KINDS = ("preempt",)
+    # executor_slow is driver-side: the drill process owns the serving
+    # engine, so it arms set_executor_slow() itself when the event is due.
+    DRIVER_KINDS = ("preempt", "executor_slow")
     #: kinds that must fire once per drill, not once per process start
     ONESHOT_KINDS = ("publish_crash", "cold_fetch", "nan_batches")
     KINDS = PROCESS_KINDS + DRIVER_KINDS
@@ -426,7 +464,10 @@ class ChaosSchedule:
                  publish_crash_stage: str = "before_rename",
                  preemptions: int = 0,
                  cold_fetch_fails: int = 0,
-                 nan_batches: int = 0) -> "ChaosSchedule":
+                 nan_batches: int = 0,
+                 executor_slow_events: int = 0,
+                 executor_slow_ms: float = 0.0,
+                 executor_slow_calls: int = 0) -> "ChaosSchedule":
         """Draw a plan for a drill of ``horizon_s`` seconds. Event times
         land in the middle 20-80% of the horizon (chaos during steady
         state, not during come-up or drain). stdlib ``random`` on purpose:
@@ -451,6 +492,14 @@ class ChaosSchedule:
             batches = sorted(rng.sample(range(2, 50), int(nan_batches)))
             events.append(ChaosEvent.make(
                 0.0, "nan_batches", batches=tuple(batches)))
+        for _ in range(int(executor_slow_events)):
+            # Early in the 20-80% window on purpose: the slow period must
+            # finish inside the horizon so the drill can also assert
+            # RECOVERY, not just engagement.
+            events.append(ChaosEvent.make(
+                rng.uniform(0.2, 0.5) * horizon_s, "executor_slow",
+                delay_ms=round(float(executor_slow_ms), 3),
+                calls=int(executor_slow_calls)))
         return cls(events, seed=int(seed))
 
     # -- serialization --------------------------------------------------

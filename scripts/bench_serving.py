@@ -1,6 +1,7 @@
 #!/usr/bin/env python
 """Serving scale-out sweep: QPS/p50/p99 per replica count and in-flight
-depth -> ``SERVING_r0N.json``.
+depth -> ``SERVING_r0N.json``. With ``--flood``, the overload sweep
+instead: open-loop Zipf flood past saturation -> ``FLOOD_r0N.json``.
 
 The measurement half of ROADMAP item 1's serving receipt (the correctness
 half is ``scripts/serving_drill.py``, re-run here so the committed report
@@ -52,11 +53,12 @@ def say(msg):
     print(f"[bench_serving] {msg}", flush=True)
 
 
-def _next_report_path():
+def _next_report_path(prefix="SERVING"):
     n = 1
-    while os.path.exists(os.path.join(_REPO_ROOT, f"SERVING_r{n:02d}.json")):
+    while os.path.exists(
+            os.path.join(_REPO_ROOT, f"{prefix}_r{n:02d}.json")):
         n += 1
-    return os.path.join(_REPO_ROOT, f"SERVING_r{n:02d}.json")
+    return os.path.join(_REPO_ROOT, f"{prefix}_r{n:02d}.json")
 
 
 def run_sweep(report_path=None, run_secs=3.0, verbose=True):
@@ -147,14 +149,103 @@ def run_sweep(report_path=None, run_secs=3.0, verbose=True):
     return report
 
 
+FLOOD_MULTS = (0.5, 1.0, 2.0, 4.0)
+
+
+def run_flood(report_path=None, run_secs=2.5, users=1_000_000,
+              verbose=True):
+    """Overload sweep -> ``FLOOD_r0N.json``: the p99-vs-offered-QPS and
+    goodput curves from half saturation to 4x past it, over a >= 1M-user
+    Zipf population with per-user history continuity, plus the drilled
+    degradation-ladder run (``production_drill.run_overload_drill``)
+    embedded so the committed report carries BOTH the curve and the
+    bit-replayable chaos receipt.
+
+    Gates: every point closes the accounting identity (offered ==
+    completed + sheds + overloads + timeouts + failed — zero hangs, zero
+    silent drops); at 4x saturation the fleet must SHED (admission
+    refusals > 0) while still completing in-SLO work (goodput > 0) —
+    degrading, not collapsing; the embedded drill must show the ladder
+    engaging under the injected ``executor_slow`` and fully recovering.
+    """
+    global say
+    if not verbose:
+        say = lambda msg: None  # noqa: E731
+    import production_drill
+
+    t_start = time.time()
+    workdir = tempfile.mkdtemp(prefix="bench_flood_")
+    try:
+        say("exporting artifacts once for the whole flood sweep")
+        bench.export_serving_artifacts(workdir)
+        say(f"flood sweep at {FLOOD_MULTS} x measured saturation, "
+            f"{users} Zipf users")
+        flood = bench.overload_series(
+            run_secs=run_secs, mults=FLOOD_MULTS, users=users,
+            artifact_dir=workdir)
+        for p in flood["points"]:
+            say(f"  {p['offered_mult']}x offered={p['offered_qps_target']} "
+                f"goodput={p['goodput_qps']} p99={p['p99_ms']}ms "
+                f"sheds={p['sheds']} overloads={p['overloads']} "
+                f"timeouts={p['timeouts']}")
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    say("overload drill (degradation ladder under executor_slow chaos)")
+    drill_dir = tempfile.mkdtemp(prefix="bench_flood_drill_")
+    try:
+        drill = production_drill.run_overload_drill(
+            drill_dir, verbose=verbose)
+    finally:
+        shutil.rmtree(drill_dir, ignore_errors=True)
+
+    for p in flood["points"]:
+        assert p["accounting_ok"], (
+            f"accounting identity broken at {p['offered_mult']}x: {p}")
+    top = max(flood["points"], key=lambda p: p["offered_mult"])
+    assert top["sheds"] + top["overloads"] > 0, (
+        f"no load shedding at {top['offered_mult']}x saturation: {top}")
+    assert top["goodput_qps"] > 0 and top["completed"] > 0, (
+        f"fleet collapsed at {top['offered_mult']}x saturation: {top}")
+    assert drill["ladder_engaged"], drill
+    assert drill["recovered"], drill
+
+    report = {
+        "bench": "serving_flood",
+        "ok": True,
+        "flood": flood,
+        "overload_drill": drill,
+        "offered_mults": list(FLOOD_MULTS),
+        "host_cpu_count": os.cpu_count() or 1,
+        "load_kind": flood["load_kind"],
+        "device_kind": flood["device_kind"],
+        "elapsed_s": round(time.time() - t_start, 1),
+    }
+    path = report_path or _next_report_path("FLOOD")
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    say(f"PASS -> {path}")
+    return report
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--report", default=None,
-                    help="report path (default: SERVING_r0N.json, next free N)")
+                    help="report path (default: SERVING_r0N.json or "
+                         "FLOOD_r0N.json with --flood, next free N)")
     ap.add_argument("--run_secs", type=float, default=3.0,
-                    help="closed-loop load duration per sweep point")
+                    help="load duration per sweep point")
+    ap.add_argument("--flood", action="store_true",
+                    help="run the overload flood sweep -> FLOOD_r0N.json "
+                         "instead of the scale-out sweep")
+    ap.add_argument("--users", type=int, default=1_000_000,
+                    help="Zipf user-population size for --flood")
     args = ap.parse_args()
-    run_sweep(args.report, run_secs=args.run_secs)
+    if args.flood:
+        run_flood(args.report, run_secs=args.run_secs, users=args.users)
+    else:
+        run_sweep(args.report, run_secs=args.run_secs)
 
 
 if __name__ == "__main__":

@@ -87,6 +87,17 @@ SMOKE = dict(duration_s=8.0, base_qps=8.0, peak_qps=30.0, max_rows=4,
 MIN_HOT_SWAPS = 3
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# Overload drill (degradation ladder under executor_slow chaos): the flood
+# plan's offered rate is sized so the ranking engine saturates only while
+# the injected slow window is live — engagement AND recovery both happen
+# inside the horizon. Count-based slow window (calls, not wall-clock), so
+# the recovery half cannot be starved by a slow host.
+OVERLOAD = dict(duration_s=3.0, offered_qps=110.0, users=1_000_000,
+                hist_len=6, retrieve_k=12, degrade_retrieve_k=4,
+                max_batch=16, queue_rows=96, shed_watermark=32,
+                slo_ms=250.0, workers=12, slow_ms=45.0, slow_calls=30,
+                timeout_s=12.0)
+
 
 def _say_factory(verbose):
     return (lambda msg: print(f"[production_drill] {msg}", flush=True)) \
@@ -775,6 +786,9 @@ def run_drill(workdir, *, seed=2026, pace=1.0, report_path=None,
         os.environ.pop("DEEPFM_TPU_SKIP_TF_EXPORT", None)
         if trace != "off":
             trace_lib.reset()  # don't leak mode/env into the caller
+    say("overload drill (degradation ladder under executor_slow)")
+    report["overload"] = run_overload_drill(
+        os.path.join(workdir, "overload"), seed=seed, verbose=verbose)
     if report_path is None:
         report_path = _next_report_path()
     if report_path:
@@ -798,6 +812,219 @@ def run_smoke(workdir, *, seed=11, pace=0.25, verbose=False, trace="off"):
         faults_lib.set_publish_crash("")  # disarm if the drill died early
         if trace != "off":
             trace_lib.reset()  # don't leak mode/env into the caller
+
+
+def build_cascade_artifact(publish_dir, *, seed=3, say=None):
+    """Train + export ONE small cascade artifact (DIN ranker + twin towers
+    + brute index), LATEST -> 1. The overload drill's serving substrate;
+    also reused by the overload tests' fixture."""
+    say = say or (lambda msg: None)
+    from deepfm_tpu.data import libsvm, pipeline as pipeline_lib
+    from deepfm_tpu.models.twin_tower import train_twin_tower
+    from deepfm_tpu.rec.cascade import ITEM_SLOT, export_cascade
+    from deepfm_tpu.rec.index import CandidateIndex
+
+    cfg = Config(
+        feature_size=FEATURE_SIZE, field_size=FIELD_SIZE, embedding_size=4,
+        deep_layers="8", dropout="1.0", batch_size=32,
+        compute_dtype="float32", mesh_data=1, log_steps=0, seed=seed,
+        scale_lr_by_world=False, model="din",
+        history_max_len=OVERLOAD["hist_len"])
+    with tempfile.TemporaryDirectory(prefix="overload_data_") as data_dir:
+        files = libsvm.generate_synthetic_ctr(
+            data_dir, num_files=1, examples_per_file=256,
+            feature_size=cfg.feature_size, field_size=cfg.field_size,
+            seed=seed, history=cfg.history_max_len)
+        batches = list(pipeline_lib.CtrPipeline(
+            files, field_size=cfg.field_size, batch_size=cfg.batch_size,
+            num_epochs=1, shuffle=False, prefetch_batches=0, history=True,
+            history_max_len=cfg.history_max_len))
+    trainer = Trainer(cfg)
+    state = trainer.init_state()
+    step_fn = trainer._make_train_step()
+    for b in batches:
+        state, _ = step_fn(state, trainer.put_batch(b))
+    tower_model, tower_params, _ = train_twin_tower(
+        cfg, batches, item_slot=ITEM_SLOT)
+    index = CandidateIndex(
+        tower_model.all_item_embeddings(tower_params, cfg.feature_size),
+        kind="brute")
+    export_cascade(trainer.model, state, cfg,
+                   os.path.join(publish_dir, "1"),
+                   tower_params=tower_params, index=index)
+    export_lib.write_latest(publish_dir, "1")
+    say(f"cascade artifact v1 live at {publish_dir}")
+    return publish_dir
+
+
+def run_overload_drill(workdir, *, seed=7, verbose=False,
+                       publish_dir=None, params=None):
+    """Graceful-degradation drill: flood a :class:`CascadeEngine` (admission
+    gate + degradation ladder armed) with open-loop Zipf traffic while a
+    seeded ``executor_slow`` chaos event throttles the ranking executor,
+    then assert the ladder ENGAGED (counted, traced rung transitions > 0),
+    the fleet answered every request with a typed outcome (ok / shed /
+    overload / timeout — zero hangs, zero silent drops), and the ladder
+    fully RECOVERED (rung 0, empty queue) after the slow window drained.
+
+    Bit-replayable: same seed => identical chaos schedule and traffic plan;
+    the audit fingerprint hashes the schedule, the plan, the parameters,
+    and the asserted outcomes — NOT timing-dependent counters — so two
+    same-seed runs on different hosts produce the identical fingerprint."""
+    say = _say_factory(verbose)
+    P = dict(OVERLOAD)
+    P.update(params or {})
+    from deepfm_tpu.loop.traffic import FloodTrafficPlan, ZipfUserPopulation
+    from deepfm_tpu.rec.cascade import CascadeEngine
+    from deepfm_tpu.serve import AdmissionShed, ServerOverloaded, ServeTimeout
+    from deepfm_tpu.serve.admission import DEGRADE_RUNGS
+
+    t_start = time.time()
+    os.environ["DEEPFM_TPU_SKIP_TF_EXPORT"] = "1"
+    try:
+        if publish_dir is None:
+            publish_dir = build_cascade_artifact(
+                os.path.join(workdir, "overload_publish"), say=say)
+        schedule = faults_lib.ChaosSchedule.generate(
+            seed, horizon_s=P["duration_s"], executor_slow_events=1,
+            executor_slow_ms=P["slow_ms"],
+            executor_slow_calls=P["slow_calls"])
+        population = ZipfUserPopulation(
+            seed, users=P["users"], hist_len=P["hist_len"])
+        plan = FloodTrafficPlan(
+            seed + 1, offered_qps=P["offered_qps"],
+            duration_s=P["duration_s"], population=population,
+            field_size=FIELD_SIZE, feature_size=FEATURE_SIZE)
+        say(f"chaos {schedule.fingerprint()} "
+            f"({len(plan.requests)} requests over {P['duration_s']}s, "
+            f"{P['users']} Zipf users)")
+        eng = CascadeEngine(
+            publish_dir, retrieve_k=P["retrieve_k"],
+            max_batch=P["max_batch"], max_delay_ms=2.0,
+            queue_rows=P["queue_rows"], slo_ms=P["slo_ms"],
+            shed_watermark=P["shed_watermark"],
+            degrade_retrieve_k=P["degrade_retrieve_k"],
+            watcher_kw={"poll_secs": 3600})
+        counters = {"ok": 0, "shed": 0, "overload": 0, "timeout": 0,
+                    "failed": 0}
+        cnt_lock = threading.Lock()
+        idx_lock = threading.Lock()
+        next_i = [0]
+        t0 = time.monotonic()
+
+        def worker():
+            while True:
+                with idx_lock:
+                    i = next_i[0]
+                    if i >= len(plan.requests):
+                        return
+                    next_i[0] = i + 1
+                r = plan.requests[i]
+                wait = t0 + r.t_s - time.monotonic()
+                if wait > 0:
+                    time.sleep(wait)
+                try:
+                    eng.recommend(r.hist_ids, r.hist_mask, r.ids[0],
+                                  r.vals[0], k=5, timeout=P["timeout_s"],
+                                  value=r.value)
+                    outcome = "ok"
+                except AdmissionShed:
+                    outcome = "shed"
+                except ServerOverloaded:
+                    outcome = "overload"
+                except ServeTimeout:
+                    outcome = "timeout"
+                except Exception as e:  # noqa: BLE001 — typed into identity
+                    say(f"request failed: {e!r}")
+                    outcome = "failed"
+                with cnt_lock:
+                    counters[outcome] += 1
+
+        threads = [threading.Thread(target=worker, name=f"flood-{k}")
+                   for k in range(P["workers"])]
+        for t in threads:
+            t.start()
+        fired = set()
+        while any(t.is_alive() for t in threads):
+            for ev in schedule.due(time.monotonic() - t0, fired):
+                if ev.kind == "executor_slow":
+                    say(f"chaos: executor_slow {ev.get('delay_ms')}ms x "
+                        f"{ev.get('calls')} flushes at t={ev.at_s}s")
+                    faults_lib.set_executor_slow(
+                        float(ev.get("delay_ms", 0.0)) / 1000.0,
+                        int(ev.get("calls", 0)))
+            time.sleep(0.01)
+        for t in threads:
+            t.join()
+
+        # Recovery: drive the ladder idle until it releases (the aged
+        # delay signal decays; count-based slow window is exhausted).
+        recovery_deadline = time.monotonic() + 10.0
+        recovered = False
+        while time.monotonic() < recovery_deadline:
+            if (eng.ladder_rung() == 0 and eng.engine.pending_rows == 0
+                    and faults_lib.executor_slow_remaining() == 0):
+                recovered = True
+                break
+            time.sleep(0.05)
+        recovery_s = round(time.monotonic() - t0 - P["duration_s"], 3)
+        log = eng.ladder.transition_log
+        max_rung = max((new for _, new, _ in log), default=0)
+        ladder_engaged = eng.ladder.transitions > 0 and max_rung >= 1
+        summary = eng.stats.summary()
+        eng.close()
+        faults_lib.set_executor_slow(0.0, 0)   # never leak the seam
+
+        total = sum(counters.values())
+        accounting_ok = total == len(plan.requests)
+        assert accounting_ok, (counters, len(plan.requests))
+        assert counters["failed"] == 0, counters
+        assert ladder_engaged, (
+            f"degradation ladder never engaged: {log}")
+        assert recovered, (
+            f"ladder did not recover: rung={eng.ladder.rung} "
+            f"pending={eng.engine.pending_rows}")
+        fingerprint = hashlib.sha256(json.dumps(
+            {"schedule": schedule.to_json(),
+             "plan": hashlib.sha256(
+                 repr(plan.fingerprint_data()).encode()).hexdigest(),
+             "params": {k: P[k] for k in sorted(P)},
+             "outcomes": {"ladder_engaged": ladder_engaged,
+                          "recovered": recovered,
+                          "accounting_ok": accounting_ok}},
+            sort_keys=True).encode()).hexdigest()[:16]
+        say(f"ladder engaged (max rung {max_rung}), recovered in "
+            f"{recovery_s}s; counters {counters}")
+        return {
+            "drill": "overload",
+            "seed": seed,
+            "params": {k: P[k] for k in sorted(P)},
+            "chaos": {"fingerprint": schedule.fingerprint(),
+                      "schedule": json.loads(schedule.to_json())},
+            "traffic": {"requests": len(plan.requests),
+                        "users": population.users,
+                        "zipf_q": population.zipf_q,
+                        "touched_users": population.touched_users},
+            "counters": counters,
+            "accounting_ok": accounting_ok,
+            "ladder_engaged": ladder_engaged,
+            "max_rung": max_rung,
+            "rung_names": list(DEGRADE_RUNGS),
+            "transition_log": [[prev, new, round(p, 3)]
+                               for prev, new, p in log],
+            "degrade_transitions": summary["degrade_transitions"],
+            "degraded_by_rung": summary["serving_degraded_by_rung"],
+            "sheds": summary["serving_sheds"],
+            "sheds_by_class": summary["serving_sheds_by_class"],
+            "admission_transitions": summary["admission_transitions"],
+            "recovered": recovered,
+            "recovery_s": recovery_s,
+            "audit_fingerprint": fingerprint,
+            "elapsed_s": round(time.time() - t_start, 1),
+        }
+    finally:
+        os.environ.pop("DEEPFM_TPU_SKIP_TF_EXPORT", None)
+        faults_lib.set_executor_slow(0.0, 0)
 
 
 def _next_report_path():

@@ -76,6 +76,11 @@ def pytest_configure(config):
         "production: closed-loop production-day drill tests (serve->log->"
         "join->train->publish feedback loop, chaos schedule, staleness/"
         "skew/loss gates); the full multi-process drill is also slow")
+    config.addinivalue_line(
+        "markers",
+        "overload: overload-plane tests (SLO-aware admission/shedding, "
+        "request hedging, degradation ladder, Zipf flood traffic); the "
+        "full flood sweep is also slow")
 
 
 # ---------------------------------------------------------------------------

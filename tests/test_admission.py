@@ -1,0 +1,295 @@
+"""Overload-plane admission tests: hysteresis ladder semantics, the typed
+shed-vs-overload distinction, EWMA delay aging, and the accounting identity
+(offered == completed + failed + overloads + sheds) on a real engine.
+
+All sleep-free: ladders are driven with explicit pressure sequences, the
+controller gets an injected clock, and the engine test pre-loads the queue
+with the batcher stopped (start=False) before letting it drain.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from deepfm_tpu.serve.admission import (
+    DEGRADE_RUNGS, VALUE_CLASSES, AdmissionController, AdmissionShed,
+    DegradationLadder, HysteresisLadder)
+from deepfm_tpu.serve.engine import ServerOverloaded, ServingEngine
+
+pytestmark = pytest.mark.overload
+
+FIELD_SIZE = 3
+
+
+def _rows(n, base=0):
+    ids = np.arange(n * FIELD_SIZE, dtype=np.int32).reshape(n, FIELD_SIZE)
+    vals = np.full((n, FIELD_SIZE), 1.0, np.float32)
+    ids[:, 0] += base
+    return ids, vals
+
+
+def base_predict(ids, vals):
+    return (ids[:, 0] + 0.5 * vals[:, 0]).astype(np.float32)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class TestHysteresisLadder:
+    def test_no_flap_sequence(self):
+        """The documented enter->hold->release contract over one sweep:
+        enter at >= threshold, HOLD in the hysteresis band, release only
+        below hysteresis * threshold."""
+        ladder = HysteresisLadder(3)  # enter at 1.0, 1.5; release 0.7, 1.05
+        pressures = [0.5, 1.0, 0.9, 0.75, 0.69, 1.5, 1.1, 1.04, 0.6]
+        expect = [0, 1, 1, 1, 0, 2, 2, 1, 0]
+        got = [ladder.update(p) for p in pressures]
+        assert got == expect, (pressures, got)
+        # 0->1, 1->0, 0->2, 2->1, 1->0: oscillation inside the band is free.
+        assert ladder.transitions == 5
+        assert [t[:2] for t in ladder.transition_log] == [
+            (0, 1), (1, 0), (0, 2), (2, 1), (1, 0)]
+
+    def test_exact_watermark_tie_escalates(self):
+        """Pressure landing EXACTLY on an enter threshold engages the level
+        (>=): at the boundary the gate protects the SLO, not the request."""
+        ladder = HysteresisLadder(3)
+        assert ladder.update(1.0) == 1
+        assert ladder.update(1.5) == 2
+
+    def test_multi_level_jump_and_direct_release(self):
+        ladder = HysteresisLadder(3)
+        assert ladder.update(9.0) == 2     # straight to the top
+        assert ladder.update(0.0) == 0     # and straight back down
+
+    def test_transition_callback_and_log_bound(self):
+        seen = []
+        ladder = HysteresisLadder(
+            2, on_transition=lambda prev, new, p: seen.append((prev, new)))
+        for _ in range(300):
+            ladder.update(1.0)
+            ladder.update(0.0)
+        assert seen[:2] == [(0, 1), (1, 0)]
+        assert ladder.transitions == 600
+        assert len(ladder.transition_log) == 256  # bounded, not unbounded
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            HysteresisLadder(0)
+        with pytest.raises(ValueError):
+            HysteresisLadder(2, hysteresis=1.0)
+        with pytest.raises(ValueError):
+            HysteresisLadder(2, step=0.0)
+
+
+class TestAdmissionController:
+    def test_sheds_lowest_class_first_and_never_critical(self):
+        ctl = AdmissionController(shed_watermark=10)
+        # Below the watermark everything is admitted.
+        assert ctl.admit("bulk", pending_rows=5) == 0
+        # At the watermark (pressure == 1.0, tie escalates): bulk shed,
+        # normal and critical still admitted.
+        with pytest.raises(AdmissionShed):
+            ctl.admit("bulk", pending_rows=10)
+        assert ctl.admit("normal", pending_rows=10) == 1
+        # At 1.5x: normal shed too; critical is NEVER admission-shed.
+        with pytest.raises(AdmissionShed):
+            ctl.admit("normal", pending_rows=15)
+        assert ctl.admit("critical", pending_rows=15) == 2
+        assert ctl.admit("critical", pending_rows=10 ** 6) == 2
+
+    def test_unknown_value_class(self):
+        ctl = AdmissionController(shed_watermark=10)
+        with pytest.raises(ValueError, match="unknown value class"):
+            ctl.admit("vip", pending_rows=0)
+
+    def test_shed_is_not_overloaded(self):
+        ctl = AdmissionController(shed_watermark=10)
+        with pytest.raises(AdmissionShed) as ei:
+            ctl.admit("bulk", pending_rows=20)
+        assert not isinstance(ei.value, ServerOverloaded)
+
+    def test_watermark_defaults_to_half_queue(self):
+        ctl = AdmissionController(queue_rows=64)
+        assert ctl.shed_watermark == 32
+        assert AdmissionController(queue_rows=1).shed_watermark == 1
+
+    def test_delay_signal_trips_gate_without_depth(self):
+        clock = FakeClock()
+        ctl = AdmissionController(slo_ms=100.0, shed_watermark=1000,
+                                  clock=clock)
+        # Delay budget = slo_ms * slo_fraction = 50ms; EWMA at 80ms means
+        # pressure 1.6 with an EMPTY queue.
+        ctl.observe_delay(80.0)
+        assert ctl.pressure(0) == pytest.approx(1.6)
+        with pytest.raises(AdmissionShed):
+            ctl.admit("bulk", pending_rows=0)
+
+    def test_delay_ewma_ages_out(self):
+        """The delay EWMA is trailing: once shedding stops traffic from
+        reaching the batcher no new delays arrive, so the signal must decay
+        (halving per slo_ms) or the ladder wedges at its peak forever."""
+        clock = FakeClock()
+        ctl = AdmissionController(slo_ms=100.0, shed_watermark=1000,
+                                  clock=clock)
+        ctl.observe_delay(200.0)           # pressure 4.0 fresh
+        assert ctl.pressure(0) == pytest.approx(4.0)
+        clock.advance(0.1)                 # one half-life (slo_ms)
+        assert ctl.pressure(0) == pytest.approx(2.0)
+        clock.advance(0.3)                 # three more
+        assert ctl.pressure(0) == pytest.approx(0.25)
+        assert ctl.admit("bulk", pending_rows=0) == 0  # gate released
+        # A fresh observation re-arms the signal at full strength.
+        ctl.observe_delay(200.0)
+        assert ctl.pressure(0) == pytest.approx(4.0)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            AdmissionController(slo_ms=-1.0)
+        with pytest.raises(ValueError):
+            AdmissionController(shed_watermark=-1)
+        with pytest.raises(ValueError):
+            AdmissionController(shed_watermark=1, classes=("only",))
+
+    def test_summary_keys(self):
+        ctl = AdmissionController(slo_ms=50.0, shed_watermark=8)
+        ctl.observe_delay(10.0)
+        s = ctl.summary()
+        assert s["admission_level"] == 0
+        assert s["admission_watermark_rows"] == 8
+        assert s["admission_slo_ms"] == 50.0
+        assert s["admission_ewma_delay_ms"] == 10.0
+
+
+class TestDegradationLadder:
+    def test_rung_names_and_transitions(self):
+        ladder = DegradationLadder()
+        assert DEGRADE_RUNGS == ("full", "reduced_retrieve",
+                                 "retrieval_only")
+        assert ladder.rung_name == "full"
+        ladder.update(1.0)
+        assert ladder.rung == 1 and ladder.rung_name == "reduced_retrieve"
+        ladder.update(1.5)
+        assert ladder.rung_name == "retrieval_only"
+        ladder.update(0.1)
+        assert ladder.rung == 0
+        assert ladder.transitions == 3
+        assert [t[:2] for t in ladder.transition_log] == [
+            (0, 1), (1, 2), (2, 0)]
+
+
+class TestEngineAccounting:
+    def test_offered_reconciles_with_typed_refusals(self):
+        """Drive one engine's gate through shed AND overload with the
+        batcher stopped, then drain: every offered request must land in
+        exactly one bucket — completed, failed, overloads, or sheds (with
+        sheds_by_class reconciling the shed total). Zero silent drops."""
+        eng = ServingEngine(
+            base_predict, max_batch=4, max_delay_ms=1.0, queue_rows=8,
+            admission_kw={"shed_watermark": 4}, start=False)
+        offered = completed = sheds = overloads = 0
+        futs = []
+        try:
+            # Queue is parked: depth pressure rises 0/4 -> 8/4 as we go.
+            for k in range(14):
+                value = VALUE_CLASSES[k % len(VALUE_CLASSES)]
+                offered += 1
+                try:
+                    futs.append(eng.submit(*_rows(1, base=k), value=value))
+                except AdmissionShed:
+                    sheds += 1
+                except ServerOverloaded:
+                    overloads += 1
+            assert sheds > 0, "gate never shed below the queue-full wall"
+            # Critical is never admission-shed, so pushing criticals walks
+            # the queue to the PHYSICAL wall: typed ServerOverloaded.
+            for k in range(14, 20):
+                offered += 1
+                try:
+                    futs.append(eng.submit(*_rows(1, base=k),
+                                           value="critical"))
+                except ServerOverloaded:
+                    overloads += 1
+            assert overloads > 0, "queue-full wall never reached"
+        finally:
+            eng.start()
+            for f in futs:
+                f.result(timeout=30.0)
+                completed += 1
+            eng.close()
+        s = eng.stats.summary()
+        assert s["serving_requests"] == completed
+        assert s["serving_sheds"] == sheds
+        assert s["serving_overloads"] == overloads
+        assert s["serving_failed"] == 0
+        assert offered == (s["serving_requests"] + s["serving_failed"]
+                           + s["serving_overloads"] + s["serving_sheds"])
+        assert sum(s["serving_sheds_by_class"].values()) == sheds
+        assert "critical" not in s["serving_sheds_by_class"]
+        assert s["admission_transitions"] >= 1
+        assert s["serve_shed_watermark"] == 4
+
+    def test_gate_releases_after_drain(self):
+        """Shed level drops back to 0 once the queue drains (hysteresis
+        release), so post-burst traffic is admitted again."""
+        eng = ServingEngine(
+            base_predict, max_batch=8, max_delay_ms=1.0, queue_rows=16,
+            admission_kw={"shed_watermark": 4}, start=False)
+        try:
+            futs = [eng.submit(*_rows(1, base=k)) for k in range(6)]
+            with pytest.raises(AdmissionShed):
+                eng.submit(*_rows(1), value="bulk")
+            eng.start()
+            for f in futs:
+                f.result(timeout=30.0)
+            # Queue empty -> depth pressure 0 -> release below hysteresis.
+            assert eng.submit(*_rows(1), value="bulk") is not None
+        finally:
+            eng.start()
+            eng.close()
+
+    def test_concurrent_submitters_account_exactly(self):
+        """Hammer the gate from several threads: the identity must hold
+        under contention, not just single-threaded."""
+        eng = ServingEngine(
+            base_predict, max_batch=4, max_delay_ms=0.5, queue_rows=8,
+            admission_kw={"shed_watermark": 4}, start=True)
+        counts = {"ok": 0, "shed": 0, "overload": 0}
+        lock = threading.Lock()
+        per_thread = 25
+
+        def worker(tid):
+            for k in range(per_thread):
+                try:
+                    eng.predict(*_rows(1, base=tid * 100 + k),
+                                timeout=30.0,
+                                value=VALUE_CLASSES[k % len(VALUE_CLASSES)])
+                    out = "ok"
+                except AdmissionShed:
+                    out = "shed"
+                except ServerOverloaded:
+                    out = "overload"
+                with lock:
+                    counts[out] += 1
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        eng.close()
+        s = eng.stats.summary()
+        assert sum(counts.values()) == 4 * per_thread
+        assert s["serving_requests"] == counts["ok"]
+        assert s["serving_sheds"] == counts["shed"]
+        assert s["serving_overloads"] == counts["overload"]
+        assert s["serving_failed"] == 0

@@ -11,9 +11,10 @@ import numpy as np
 import pytest
 
 from deepfm_tpu.data.shm_ring import THREAD_CTX
-from deepfm_tpu.serve import (FrontendServer, ReplicatedEngine,
+from deepfm_tpu.serve import (AdmissionShed, FrontendServer, ReplicatedEngine,
                               ServerOverloaded, ServingClient, ServingEngine,
                               ServingStats, aggregate_summary)
+from deepfm_tpu.serve.replicas import HedgedFuture
 
 pytestmark = pytest.mark.serving
 
@@ -199,6 +200,215 @@ class TestFleetLifecycle:
             for eng in fleet.engines:
                 eng._watcher = None
             fleet.close(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# Per-attempt routing re-snapshot (regression) + request hedging
+# ---------------------------------------------------------------------------
+
+class TestRoutingResnapshot:
+    def test_spill_burst_spreads_by_live_pending_rows(self):
+        """``_next_attempt`` re-reads pending rows at EVERY attempt: a
+        burst of spills off a full home replica spreads across the fleet
+        instead of piling onto whichever replica was least loaded when the
+        first spill was computed."""
+        fleet = _fleet(3, start=False, max_batch=8, queue_rows=8)
+        try:
+            fleet.engines[0].submit(*_rows(8))         # home full
+            for _ in range(3):
+                fleet.submit(*_rows(4), affinity=0)
+            # 1st spill -> r1 (tie, lowest idx), 2nd -> r2 (r1 now has 4),
+            # 3rd -> r1 (tie again at 4 rows each).
+            assert fleet.routed == [0, 2, 1]
+            assert fleet.spills == 3
+            assert [e.pending_rows for e in fleet.engines] == [8, 8, 4]
+        finally:
+            for e in fleet.engines:
+                e.start()
+            fleet.close(timeout=30)
+
+    def test_blocked_spill_target_reroutes_past_it(self):
+        """The least-loaded spill target refusing (a dead replica shows 0
+        pending, so it LOOKS least loaded) must not end the attempt walk:
+        the next attempt re-snapshots and lands on a live replica."""
+        fleet = _fleet(3, start=False, max_batch=4, queue_rows=4)
+        try:
+            fleet.engines[0].submit(*_rows(4))         # home full
+            fleet.engines[2].submit(*_rows(1))
+            fleet.engines[1].close(timeout=10)         # blocked: pending 0
+            fut = fleet.submit(*_rows(1), affinity=0)
+            assert fleet.routed == [0, 0, 1]
+            assert fleet.spills == 1
+            assert not fut.done()
+        finally:
+            for e in (fleet.engines[0], fleet.engines[2]):
+                e.start()
+            fleet.close(timeout=30)
+
+
+class TestHedging:
+    def _hedged_fleet(self, n=2, hedge_ms=5.0, **kw):
+        kw.setdefault("max_batch", 8)
+        kw.setdefault("max_delay_ms", 1)
+        # start=False: no hedger thread — tests drive hedge_pass() by hand.
+        return ReplicatedEngine(
+            [ServingEngine(base_predict, start=False, **kw)
+             for _ in range(n)],
+            hedge_ms=hedge_ms, start=False)
+
+    def test_hedge_fires_to_other_replica_and_wins(self):
+        """Primary parked on a blocked replica: after the hedge delay the
+        monitor re-submits to the least-loaded OTHER replica, the hedge
+        resolves first, the caller gets its result, and the loser is
+        cancelled — all counted (fired/won/cancelled)."""
+        fleet = self._hedged_fleet()
+        try:
+            hf = fleet.submit(*_rows(1, base=3), affinity=0)
+            assert isinstance(hf, HedgedFuture) and not hf.hedged
+            # Not yet past the delay: nothing fires.
+            assert fleet.hedge_pass(now=hf.t_enqueue) == 0
+            assert fleet.hedge_pass(now=hf.t_enqueue + 1.0) == 1
+            assert hf.hedged
+            assert fleet.engines[1].pending_rows == 1
+            # Second pass never double-hedges the same wrapper.
+            assert fleet.hedge_pass(now=hf.t_enqueue + 2.0) == 0
+            fleet.engines[1].start()
+            np.testing.assert_array_equal(
+                hf.result(timeout=10), np.full(1, 3.5, np.float32))
+            assert hf._primary.cancelled()
+            s = fleet.summary()
+            assert s["hedges_fired"] == 1
+            assert s["hedges_won"] == 1
+            assert s["hedges_cancelled"] == 1
+            # The resolved wrapper prunes into the p99 window.
+            fleet.hedge_pass(now=hf.t_enqueue + 3.0)
+            assert len(fleet._recent_latencies) == 1
+        finally:
+            for e in fleet.engines:
+                e.start()
+            fleet.close(timeout=30)
+
+    def test_late_loser_never_double_resolves(self):
+        """A cancelled loser that was already mid-flush resolving late is
+        harmless: the wrapper's result and latency stamp are immutable
+        after the winner."""
+        fleet = self._hedged_fleet()
+        try:
+            hf = fleet.submit(*_rows(1, base=3), affinity=0)
+            fleet.hedge_pass(now=hf.t_enqueue + 1.0)
+            fleet.engines[1].start()
+            want = hf.result(timeout=10)
+            stamp = hf.latency_ms
+            # The loser resolves anyway (as if mid-flush at cancel time).
+            hf._primary.set_result(np.full(1, -99.0, np.float32), 0.0)
+            np.testing.assert_array_equal(hf.result(timeout=0), want)
+            assert hf.latency_ms == stamp
+            assert fleet.summary()["hedges_won"] == 1
+        finally:
+            for e in fleet.engines:
+                e.start()
+            fleet.close(timeout=30)
+
+    def test_attach_after_race_over_is_refused_and_cancelled(self):
+        fleet = self._hedged_fleet()
+        try:
+            hf = fleet.submit(*_rows(1, base=2), affinity=0)
+            hf._primary.set_result(np.full(1, 2.5, np.float32), 1.0)
+            late = fleet.engines[1].submit(*_rows(1, base=2))
+            assert hf.attach_hedge(late) is False
+            assert late.cancelled()
+            assert fleet.summary()["hedges_fired"] == 0
+        finally:
+            for e in fleet.engines:
+                e.start()
+            fleet.close(timeout=30)
+
+    def test_errored_primary_holds_wrapper_for_healthy_hedge(self):
+        """A failed primary with a hedge in flight does NOT resolve the
+        wrapper: the caller only sees an error when no leg can succeed."""
+        fleet = self._hedged_fleet()
+        try:
+            hf = fleet.submit(*_rows(1, base=4), affinity=0)
+            fleet.hedge_pass(now=hf.t_enqueue + 1.0)
+            hf._primary.set_error(RuntimeError("primary boom"))
+            assert not hf.done()
+            fleet.engines[1].start()
+            np.testing.assert_array_equal(
+                hf.result(timeout=10), np.full(1, 4.5, np.float32))
+            assert fleet.summary()["hedges_won"] == 1
+        finally:
+            for e in fleet.engines:
+                e.start()
+            fleet.close(timeout=30)
+
+    def test_both_legs_failing_surfaces_the_error(self):
+        fleet = self._hedged_fleet()
+        try:
+            hf = fleet.submit(*_rows(1, base=4), affinity=0)
+            fleet.hedge_pass(now=hf.t_enqueue + 1.0)
+            hf._primary.set_error(RuntimeError("primary boom"))
+            hf._hedge.set_error(RuntimeError("hedge boom"))
+            assert hf.done()
+            with pytest.raises(RuntimeError, match="boom"):
+                hf.result(timeout=0)
+            assert fleet.summary()["hedges_won"] == 0
+        finally:
+            for e in fleet.engines:
+                e.start()
+            fleet.close(timeout=30)
+
+    def test_hot_fleet_skips_hedge_and_retries_next_pass(self):
+        """When every other replica refuses the hedge submission (full
+        queue), the pass skips it — the wrapper stays eligible and hedges
+        on a later pass once capacity returns."""
+        fleet = self._hedged_fleet(max_batch=4, queue_rows=4)
+        try:
+            hf = fleet.submit(*_rows(1), affinity=0)
+            fleet.engines[1].submit(*_rows(3))   # only 1 row of room left
+            fleet.engines[1].submit(*_rows(1))   # ...now zero
+            assert fleet.hedge_pass(now=hf.t_enqueue + 1.0) == 0
+            assert not hf.hedged
+            fleet.engines[1].start()
+            fleet.engines[1].close(timeout=10)   # drains; capacity back...
+            # ...but a closed replica refuses: still no hedge, no crash.
+            assert fleet.hedge_pass(now=hf.t_enqueue + 2.0) == 0
+        finally:
+            for e in fleet.engines:
+                e.start()
+            fleet.close(timeout=30)
+
+    def test_hedge_delay_tracks_fleet_p99_above_floor(self):
+        fleet = self._hedged_fleet(hedge_ms=5.0)
+        try:
+            assert fleet.hedge_delay_s() == pytest.approx(0.005)
+            # Under 20 samples the floor still rules.
+            fleet._recent_latencies.extend([100.0] * 19)
+            assert fleet.hedge_delay_s() == pytest.approx(0.005)
+            fleet._recent_latencies.append(100.0)
+            assert fleet.hedge_delay_s() == pytest.approx(0.1)
+        finally:
+            for e in fleet.engines:
+                e.start()
+            fleet.close(timeout=30)
+
+    def test_all_sheds_raise_typed_admission_shed(self):
+        """When EVERY replica's refusal was an admission shed, the fleet
+        raises AdmissionShed (the fleet CHOSE to refuse the class), not
+        ServerOverloaded."""
+        fleet = ReplicatedEngine(
+            [ServingEngine(base_predict, start=False, max_batch=8,
+                           max_delay_ms=1, queue_rows=8,
+                           admission_kw={"shed_watermark": 2})
+             for _ in range(2)], start=False)
+        try:
+            for e in fleet.engines:
+                e.submit(*_rows(2), value="critical")
+            with pytest.raises(AdmissionShed, match="all 2 replicas"):
+                fleet.submit(*_rows(1), value="bulk")
+        finally:
+            for e in fleet.engines:
+                e.start()
+            fleet.close(timeout=30)
 
 
 # ---------------------------------------------------------------------------

@@ -17,7 +17,8 @@ sys.path.insert(0, os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"))
 
 from deepfm_tpu.config import Config
-from deepfm_tpu.serve import (ServerOverloaded, ServingEngine, ServingStats)
+from deepfm_tpu.serve import (ServerOverloaded, ServeTimeout, ServingEngine,
+                              ServingStats)
 from deepfm_tpu.utils import export as export_lib
 
 pytestmark = pytest.mark.serving
@@ -193,6 +194,21 @@ class TestDemux:
             assert probs.shape == (n,)
             np.testing.assert_array_equal(probs, first_col_predict(ids, vals))
             assert fut.latency_ms is not None and fut.latency_ms >= 0
+
+    def test_result_timeout_is_typed(self):
+        """An unresolved future raises ServeTimeout (a TimeoutError
+        subclass) — typed so frontends forward it distinctly from a
+        predict failure — and the request is NOT abandoned server-side:
+        the engine still resolves it on drain."""
+        eng = ServingEngine(first_col_predict, max_batch=4,
+                            max_delay_ms=10_000, start=False)
+        fut = eng.submit(*_rows(2))
+        with pytest.raises(ServeTimeout, match="2 rows"):
+            fut.result(timeout=0.01)
+        assert isinstance(ServeTimeout("x"), TimeoutError)
+        eng.start()
+        eng.close(timeout=10)
+        assert fut.result(timeout=0).shape == (2,)
 
     def test_flushes_are_bucketed(self):
         eng = ServingEngine(first_col_predict, max_batch=8, max_delay_ms=5,
