@@ -1208,6 +1208,58 @@ def pallas_ab_device_ratio() -> dict:
     }
 
 
+def embedding_kernels_series() -> dict:
+    """Fused-embedding-plane regression canary: dense vs seed-sparse
+    (``--embedding_kernels off``) vs fused-sparse (``auto``) ms/step at
+    the EMBED bench shape, few steps (compile excluded). The claims under
+    guard: the fused sparse step stays at or under dense
+    (``sparse_beats_dense``, EMBED_r02 headline) and well under the seed
+    formulation. Full per-kernel A/Bs + per-stage breakdown live in
+    scripts/bench_embedding.py; this is the cheap canary that rides the
+    main bench."""
+    import jax
+
+    from deepfm_tpu.config import Config
+    from deepfm_tpu.train import Trainer
+
+    v, b, f, nb = 100_000, 1024, 39, 16
+    rng = np.random.default_rng(3)
+    batches = [dict(
+        feat_ids=rng.integers(0, v, size=(b, f)).astype(np.int32),
+        feat_vals=rng.normal(size=(b, f)).astype(np.float32),
+        label=rng.integers(0, 2, size=(b,)).astype(np.float32))
+        for _ in range(nb + 2)]
+    out = {"V": v, "B": b, "steps": nb}
+    for label, kw in (
+            ("dense", dict(embedding_update="dense")),
+            ("sparse_seed", dict(embedding_update="sparse",
+                                 embedding_kernels="off")),
+            ("sparse_fused", dict(embedding_update="sparse",
+                                  embedding_kernels="auto"))):
+        cfg = Config(
+            feature_size=v, field_size=f, embedding_size=8,
+            deep_layers="32,16", dropout="1.0,1.0", batch_size=b,
+            compute_dtype="float32", l2_reg=0.0, learning_rate=0.001,
+            log_steps=0, seed=11, scale_lr_by_world=False, mesh_data=1,
+            mesh_model=1, steps_per_loop=1, transfer_ahead=0, **kw)
+        tr = Trainer(cfg)
+        st = tr.init_state()
+        st, _ = tr.fit(st, batches[:2])  # compile
+        t0 = time.perf_counter()
+        st, summary = tr.fit(st, batches[2:])
+        jax.block_until_ready(st.params)
+        out[f"{label}_ms_per_step"] = round(
+            (time.perf_counter() - t0) * 1000.0 / max(summary["steps"], 1),
+            3)
+    out["fused_over_dense_ratio"] = round(
+        out["sparse_fused_ms_per_step"] / out["dense_ms_per_step"], 3)
+    out["fused_speedup_vs_seed"] = round(
+        out["sparse_seed_ms_per_step"] / out["sparse_fused_ms_per_step"], 2)
+    out["sparse_beats_dense"] = bool(
+        out["sparse_fused_ms_per_step"] <= out["dense_ms_per_step"])
+    return out
+
+
 def scaling_probe() -> None:
     """--scaling mode (run in a subprocess): 1-dev vs 8-dev DP vs 4x2
     DP x row-shard on a virtual CPU mesh; prints one JSON line. The value
@@ -1331,6 +1383,12 @@ def main() -> None:
         pallas_ab = {"error": str(e)}
 
     try:
+        embedding_kernels = embedding_kernels_series()
+    except Exception as e:
+        print(f"bench: embedding-kernels series error: {e}", file=sys.stderr)
+        embedding_kernels = {"error": str(e)}
+
+    try:
         device_resident = device_resident_series()
     except Exception as e:
         print(f"bench: device-resident series error: {e}", file=sys.stderr)
@@ -1413,6 +1471,7 @@ def main() -> None:
         "mfu_basis": mfu_basis,
         "host_series": host_series,
         "pallas_ab_device": pallas_ab,
+        "embedding_kernels": embedding_kernels,
         "device_resident": device_resident,
         "online_publish": online_publish,
         "serving": serving,

@@ -289,9 +289,19 @@ class Config:
     # table layout, and a single-device mesh.
     embedding_tiering: str = "off"    # off | hot_cold
     embedding_hot_rows: int = 0       # hot-cache capacity in rows (tiering)
-    # Cold-store precision: float32, or int8 with a per-row dequant scale
-    # (halves→quarters host bytes; fetch dequantizes, writeback requantizes).
-    embedding_cold_dtype: str = "float32"  # float32 | int8
+    # Cold-store precision: float32; int8 or fp8_e4m3 store quantized rows
+    # with a per-row dequant scale (fetch dequantizes, writeback
+    # requantizes) at 1/4 the float32 host bytes. fp8 keeps ~2 mantissa
+    # bits of relative precision per element vs int8's fixed grid.
+    embedding_cold_dtype: str = "float32"  # float32 | int8 | fp8_e4m3
+    # Sparse embedding-plane kernel selection (ops/pallas_embedding.py):
+    # "auto" = Pallas kernels on TPU where the probe passes, the optimized
+    # XLA legs (counting plan build, fused one-leaf backward, select
+    # writeback, fused cache install) elsewhere; "pallas" forces Pallas
+    # where possible; "xla" forces the optimized XLA legs even on TPU;
+    # "off" is the kill switch — the seed formulation everywhere,
+    # bit-for-bit. TUNING §2.11 has the selection table.
+    embedding_kernels: str = "auto"   # auto | pallas | xla | off
 
     # ---- checkpoint / export / logging ----
     model_dir: str = ""               # checkpoint dir (shared storage; reference :434)
@@ -547,10 +557,14 @@ class Config:
             raise ValueError(
                 f"embedding_tiering must be off|hot_cold, got "
                 f"{self.embedding_tiering!r}")
-        if self.embedding_cold_dtype not in ("float32", "int8"):
+        if self.embedding_cold_dtype not in ("float32", "int8", "fp8_e4m3"):
             raise ValueError(
-                f"embedding_cold_dtype must be float32|int8, got "
+                f"embedding_cold_dtype must be float32|int8|fp8_e4m3, got "
                 f"{self.embedding_cold_dtype!r}")
+        if self.embedding_kernels not in ("auto", "pallas", "xla", "off"):
+            raise ValueError(
+                f"embedding_kernels must be auto|pallas|xla|off, got "
+                f"{self.embedding_kernels!r}")
         if self.embedding_tiering == "hot_cold":
             if self.embedding_update != "sparse":
                 raise ValueError(

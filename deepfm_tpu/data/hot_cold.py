@@ -27,10 +27,13 @@ the sparse-update plan's OOB fill (``padded_vocab`` > hot_rows) still drops
 in the hot-table scatter, and JAX's immutable arrays make installs for
 dispatch t+1 invisible to the already-enqueued dispatch t.
 
-Optional int8 cold storage (``--embedding_cold_dtype int8``) halves the
-host bytes of the weight tables with a scale-per-row dequant on fetch /
-requant on write-back; the m/v moment slots stay float32 (quantizing the
-second moment distorts the Adam denominator far more than the weights).
+Optional quantized cold storage quarters the host bytes of the weight
+tables with a scale-per-row dequant on fetch / requant on write-back:
+``--embedding_cold_dtype int8`` (fixed-step symmetric) or ``fp8_e4m3``
+(float8, scale = row-max/448 — relative precision within the row, so rows
+mixing tiny and large coordinates quantize better). The m/v moment slots
+stay float32 (quantizing the second moment distorts the Adam denominator
+far more than the weights).
 """
 
 from __future__ import annotations
@@ -46,6 +49,7 @@ import numpy as np
 
 from ..config import Config
 from ..obs import trace as trace_lib
+from ..ops import pallas_embedding as pemb
 from ..utils import faults
 from ..utils import logging as ulog
 
@@ -59,56 +63,115 @@ def _pow2_pad(n: int) -> int:
     return p
 
 
+try:  # fp8 cold tier needs ml_dtypes (ships with jax; gated anyway)
+    import ml_dtypes as _mld
+    _FP8_DTYPE = np.dtype(_mld.float8_e4m3fn)
+    _FP8_MAX = float(_mld.finfo(_mld.float8_e4m3fn).max)  # 448.0
+except ImportError:  # pragma: no cover - baked into the image
+    _mld = None
+    _FP8_DTYPE = None
+    _FP8_MAX = 0.0
+
+# __init__ quantizes the adopted table through write() in chunks of this
+# many rows, so the write scratch stays bounded instead of growing to a
+# full-vocab float32 temp (which would cancel the quantized tiers' memory
+# saving at adopt time).
+_INIT_WRITE_CHUNK = 8192
+
+
 class ColdStore:
-    """Host-RAM row store for ONE table: float32, or int8 with a per-row
-    float32 scale (row-max/127 symmetric quant, dequant on fetch)."""
+    """Host-RAM row store for ONE table: float32, or a quantized tier with
+    a per-row float32 scale — ``int8`` (row-max/127 symmetric, rint) or
+    ``fp8_e4m3`` (row-max/448, cast-rounded; fp8 keeps ~3 mantissa bits
+    everywhere in the row instead of int8's fixed step, so small
+    coordinates in a row with one large outlier survive quantization).
+
+    fetch()/write() run on every cache transaction, so both work out of
+    per-store scratch buffers: ``fetch`` returns a VIEW into the scratch,
+    valid until the next fetch/write on this store — callers copy out
+    (every runtime call site assigns into its own array immediately)."""
 
     def __init__(self, array: np.ndarray, dtype: str):
         a = np.asarray(array, np.float32)
         self.shape = a.shape
         self.dtype = dtype
         self._trail = tuple(range(1, a.ndim))
-        if dtype == "int8":
+        self._fetch_f32: Optional[np.ndarray] = None  # fetch dequant out
+        self._fetch_q: Optional[np.ndarray] = None    # fetch raw-row stage
+        self._write_f32: Optional[np.ndarray] = None  # write quant stage
+        if dtype in ("int8", "fp8_e4m3"):
+            if dtype == "fp8_e4m3":
+                if _mld is None:
+                    raise RuntimeError(
+                        "embedding_cold_dtype=fp8_e4m3 needs ml_dtypes")
+                self._qdt, self._qmax = _FP8_DTYPE, _FP8_MAX
+            else:
+                self._qdt, self._qmax = np.dtype(np.int8), 127.0
             self._scale = np.empty(a.shape[:1], np.float32)
-            self._q = np.empty(a.shape, np.int8)
-            self.write(np.arange(a.shape[0]), a)
+            self._q = np.empty(a.shape, self._qdt)
+            for lo in range(0, a.shape[0], _INIT_WRITE_CHUNK):
+                hi = min(lo + _INIT_WRITE_CHUNK, a.shape[0])
+                self.write(np.arange(lo, hi), a[lo:hi])
         elif dtype == "float32":
             self._data = a.copy()
         else:
             raise ValueError(f"unknown cold dtype {dtype!r}")
 
     def nbytes(self) -> int:
-        if self.dtype == "int8":
+        if self.dtype != "float32":
             return self._q.nbytes + self._scale.nbytes
         return self._data.nbytes
 
+    def _scratch(self, which: str, n: int) -> np.ndarray:
+        """First-n-rows view of the named scratch buffer, growing it to the
+        next power of two when the request outsizes it (so steady-state
+        transactions of any mix of sizes stop allocating)."""
+        buf = getattr(self, which)
+        if buf is None or buf.shape[0] < n:
+            cap = _pow2_pad(n)
+            dt = self._qdt if which == "_fetch_q" else np.float32
+            buf = np.empty((cap,) + self.shape[1:], dt)
+            setattr(self, which, buf)
+        return buf[:n]
+
     def fetch(self, ids: np.ndarray) -> np.ndarray:
-        """float32 rows at ``ids`` (dequantized for int8). The fault seam
+        """float32 rows at ``ids`` (dequantized for the quantized tiers),
+        as a reused-scratch VIEW (see class docstring). The fault seam
         fires here — callers retry via :meth:`TieredEmbeddingRuntime`."""
         faults.check_cold_fetch()
         ids = np.asarray(ids, np.int64)
-        if self.dtype == "int8":
-            scale = self._scale[ids].reshape(
-                (-1,) + (1,) * len(self._trail))
-            return self._q[ids].astype(np.float32) * scale
-        return self._data[ids].copy()
+        out = self._scratch("_fetch_f32", ids.size)
+        if self.dtype != "float32":
+            q = self._scratch("_fetch_q", ids.size)
+            np.take(self._q, ids, axis=0, out=q)
+            np.copyto(out, q, casting="unsafe")
+            out *= self._scale[ids].reshape((-1,) + (1,) * len(self._trail))
+        else:
+            np.take(self._data, ids, axis=0, out=out)
+        return out
 
     def write(self, ids: np.ndarray, rows: np.ndarray) -> None:
         ids = np.asarray(ids, np.int64)
         rows = np.asarray(rows, np.float32)
-        if self.dtype == "int8":
-            amax = np.abs(rows).max(axis=self._trail) if self._trail \
-                else np.abs(rows)
-            scale = np.maximum(amax, 1e-12).astype(np.float32) / 127.0
-            self._scale[ids] = scale
-            q = np.rint(rows / scale.reshape((-1,) + (1,) * len(self._trail)))
-            self._q[ids] = np.clip(q, -127, 127).astype(np.int8)
-        else:
+        if self.dtype == "float32":
             self._data[ids] = rows
+            return
+        w = self._scratch("_write_f32", ids.size)
+        np.abs(rows, out=w)
+        amax = w.max(axis=self._trail) if self._trail else w.copy()
+        scale = np.maximum(amax, 1e-12, out=amax)
+        scale /= self._qmax
+        self._scale[ids] = scale
+        np.divide(rows, scale.reshape((-1,) + (1,) * len(self._trail)),
+                  out=w)
+        if self.dtype == "int8":
+            np.rint(w, out=w)  # fp8 rounds in the cast; int8 truncates
+        np.clip(w, -self._qmax, self._qmax, out=w)
+        self._q[ids] = w  # casts on assignment, no full-size temp
 
     def dense(self) -> np.ndarray:
         """The whole table as float32 (eval/export densification)."""
-        if self.dtype == "int8":
+        if self.dtype != "float32":
             return self._q.astype(np.float32) * self._scale.reshape(
                 (-1,) + (1,) * len(self._trail))
         return self._data.copy()
@@ -355,17 +418,29 @@ class TieredEmbeddingRuntime:
         return out
 
     # -- main-thread side -----------------------------------------------
-    def _install(self, table: jax.Array, slots: np.ndarray,
-                 vals: np.ndarray) -> jax.Array:
-        """Padded scatter-install: slots/vals padded to the next power of
-        two with the OOB slot id ``hot_rows`` (dropped by the scatter), so
-        compile count stays O(log max_group) per table shape."""
+    def _pad_slots(self, slots: np.ndarray) -> np.ndarray:
+        """Slot list padded to the next power of two with the OOB slot id
+        ``hot_rows`` (dropped by the scatter), so compile count stays
+        O(log max_group) per table shape."""
         p = _pow2_pad(max(slots.size, 1))
         ps = np.full((p,), self.hot_rows, np.int32)
         ps[: slots.size] = slots
+        return ps
+
+    @staticmethod
+    def _pad_vals(p: int, n: int, vals: np.ndarray) -> np.ndarray:
         pv = np.zeros((p,) + vals.shape[1:], vals.dtype)
-        pv[: slots.size] = vals
-        return _jit_install(table, ps, pv)
+        pv[:n] = vals
+        return pv
+
+    def _install(self, table: jax.Array, slots: np.ndarray,
+                 vals: np.ndarray) -> jax.Array:
+        """Per-array padded scatter-install (the ``--embedding_kernels
+        off`` seed path; the kernel path batches a whole transaction
+        through ops.pallas_embedding.install_rows instead)."""
+        ps = self._pad_slots(slots)
+        return _jit_install(
+            table, ps, self._pad_vals(ps.size, slots.size, vals))
 
     def apply_next(self, state):
         """Apply the oldest queued plan to ``state``: write evicted rows
@@ -418,14 +493,36 @@ class TieredEmbeddingRuntime:
         if plan.install_slots.size:
             s = plan.install_slots
             from ..train import optimizers as opt_lib  # noqa: PLC0415
+            kmode = self.cfg.embedding_kernels
+            ps = self._pad_slots(s)
             for name in self.names:
                 vals = plan.values[name]
                 oe = embed[name]["table"]
-                params[name] = self._install(params[name], s, vals["w"])
-                embed[name] = {"table": opt_lib.EmbedAdamEntry(
-                    m=self._install(oe.m, s, vals["m"]),
-                    v=self._install(oe.v, s, vals["v"]),
-                    tau=self._install(oe.tau, s, vals["tau"]))}
+                out = None
+                if kmode != "off":
+                    # ONE launch per (table, transaction): the weight rows
+                    # and all three lazy-Adam companions install together
+                    # (ops.pallas_embedding.install_rows); element-identical
+                    # to the seed per-array scatters, so the tiering parity
+                    # pins hold across the kill switch.
+                    out = pemb.install_rows(
+                        params[name], oe.m, oe.v, oe.tau, ps,
+                        self._pad_vals(ps.size, s.size, vals["w"]),
+                        self._pad_vals(ps.size, s.size, vals["m"]),
+                        self._pad_vals(ps.size, s.size, vals["v"]),
+                        self._pad_vals(ps.size, s.size, vals["tau"]),
+                        mode=kmode)
+                if out is not None:
+                    w_new, m_new, v_new, tau_new = out
+                    params[name] = w_new
+                    embed[name] = {"table": opt_lib.EmbedAdamEntry(
+                        m=m_new, v=v_new, tau=tau_new)}
+                else:
+                    params[name] = self._install(params[name], s, vals["w"])
+                    embed[name] = {"table": opt_lib.EmbedAdamEntry(
+                        m=self._install(oe.m, s, vals["m"]),
+                        v=self._install(oe.v, s, vals["v"]),
+                        tau=self._install(oe.tau, s, vals["tau"]))}
         with self._cond:
             self.pin_count[plan.group_slots] -= 1
             self._cond.notify_all()

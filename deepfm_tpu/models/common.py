@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops import embedding as emb_ops
+from ..ops import pallas_embedding as pemb
 
 Params = Dict[str, Any]
 State = Dict[str, Any]
@@ -233,6 +234,7 @@ class EmbeddingSchema:
         self.hashed = bool(self.buckets)
         self.assign = cfg.embedding_assign
         self.lookup_strategy = cfg.embedding_lookup
+        self.kernels = getattr(cfg, "embedding_kernels", "auto")
         self.padded_vocab = emb_ops.padded_vocab(
             cfg.feature_size, cfg.mesh_model)
 
@@ -298,15 +300,17 @@ class EmbeddingSchema:
         override keeps intent readable)."""
         if not self.hashed:
             rows = self.padded_vocab if num_rows is None else int(num_rows)
-            return {self.MONO: emb_ops.make_plan(feat_ids, rows)}
+            return {self.MONO: pemb.plan_build(feat_ids, rows,
+                                               mode=self.kernels)}
         table_of = self._table_of(feat_ids)
         plan = {}
         for i, b in enumerate(self.buckets):
             bucket = emb_ops.hash_bucket(feat_ids, b, salt=i + 1)
             sel = table_of == i
             per_table = jnp.where(sel, bucket, jnp.int32(b))  # OOB when not ours
-            plan[f"t{i}"] = emb_ops.make_plan(
-                per_table, b, mask=sel.astype(jnp.float32))
+            plan[f"t{i}"] = pemb.plan_build(
+                per_table, b, mask=sel.astype(jnp.float32),
+                mode=self.kernels)
         return plan
 
     def tables(self, entry: Any) -> Dict[str, jax.Array]:
@@ -323,8 +327,14 @@ class EmbeddingSchema:
         return {k: emb_ops.gather_rows(tabs[k], plan[k]) for k in plan}
 
     def lookup_rows(self, rows: Dict[str, jax.Array],
-                    plan: Dict[str, emb_ops.PlanEntry]) -> jnp.ndarray:
-        """[B,F,*trailing] forward view over pre-gathered rows."""
+                    plan: Optional[Dict[str, emb_ops.PlanEntry]]
+                    ) -> jnp.ndarray:
+        """[B,F,*trailing] forward view over pre-gathered rows. When
+        ``plan`` is None the rows are already the [B,F,...] batch view
+        (the fused-backward path remaps once for all params up front)."""
+        if plan is None:
+            assert len(rows) == 1
+            return next(iter(rows.values()))
         out = None
         for k in plan:
             part = emb_ops.lookup_rows(rows[k], plan[k])

@@ -1,0 +1,396 @@
+"""Pallas TPU kernels for the sparse embedding plane, with XLA fallbacks.
+
+EMBED_r01 measured the sparse update path losing to dense (40.8 vs 14.7
+ms/step at V=100k) because its three hot seams ran on XLA defaults:
+
+  1. **plan build** — ``make_plan``'s ``jnp.unique(size=N)`` lowers to a
+     sort-based program (~26 ms at N=40k on XLA:CPU);
+  2. **gather + segment-sum cotangent** — one forward gather per embedding
+     name, and one batch-sized scatter-add per name in the backward;
+  3. **cache install** — ``TieredEmbeddingRuntime`` launched one pow2-padded
+     jit scatter per array (w/m/v/tau = 4 launches) per transaction.
+
+Each seam here has up to three legs, selected by :func:`resolve`:
+
+  * ``pallas`` — a fused kernel (this module), compiled only on TPU behind
+    :func:`supported`; every kernel also runs through the Pallas
+    interpreter on CPU (``interpret=True``) so the tier-1 suite checks the
+    kernel bodies against NumPy oracles without TPU hardware.
+  * ``opt`` — a restructured XLA program with bit-identical outputs: the
+    counting plan build (``ops.embedding.make_plan_counting``), the
+    select-writeback (``scatter_rows`` on counting plans), and the fused
+    multi-array install. These are what ``auto`` picks on non-TPU backends.
+  * ``ref`` — the seed formulation, byte-for-byte (``--embedding_kernels
+    off`` restores it everywhere: the kill switch).
+
+Selection is static per (backend, shape) from the committed A/B table in
+EMBED_r02.json — a leg only becomes the default where it measured a
+clean-band win; ties and losses keep the reference leg (TUNING §2.11 has
+the table). The one shape-dependent rule: the counting plan build does a
+vocab-shaped prefix sum, so it wins only while the physical table is small
+relative to the sort cost — above ``PLAN_COUNT_MAX_ROWS`` rows ``auto``
+keeps the sort-based ``make_plan`` (and with it the scatter writeback,
+whose cost does not scale with the vocab).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from . import embedding as emb_ops
+
+try:  # pltpu import fails on some non-TPU builds; interpret mode never needs it
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+#: embedding_kernels values (config-validated).
+MODES = ("auto", "pallas", "xla", "off")
+
+# The counting plan build costs one [rows+1] prefix sum + one presence
+# scatter; the sort-based unique costs O(N log N) independent of rows.
+# Measured crossover on XLA:CPU is far above the largest physical table in
+# the bench sweep (4x262144 hashed buckets, 100k monolithic); 2M rows keeps
+# a safety margin before the vocab-shaped pass could dominate.
+PLAN_COUNT_MAX_ROWS = 2_000_000
+
+# VMEM budget for the compiled kernels (per pallas_fm: ~16MB/core, leave
+# headroom). The gather/segsum kernels keep the [U, D] row block plus the
+# [N, D] batch block live; the plan kernel keeps the [rows+1] count vector.
+_VMEM_BUDGET = 14 * 1024 * 1024
+
+
+def supported(kernel: str, *, num_rows: int = 0, n_ids: int = 0,
+              width: int = 1) -> bool:
+    """True when ``kernel`` ("plan" | "take" | "install") can run COMPILED
+    at this shape — requires a TPU backend and the kernel's working set to
+    fit VMEM. CPU/GPU backends always gate the compiled path off (the
+    interpreter is a numerics tool, not a fast path)."""
+    if pltpu is None or jax.default_backend() != "tpu":
+        return False
+    if kernel == "plan":
+        return 4 * (num_rows + 1) + 3 * 4 * n_ids <= _VMEM_BUDGET
+    if kernel == "take":
+        return 4 * width * (2 * n_ids) <= _VMEM_BUDGET
+    if kernel == "install":
+        return 4 * width * (2 * n_ids) <= _VMEM_BUDGET
+    raise ValueError(f"unknown kernel {kernel!r}")
+
+
+def resolve(mode: str, kernel: str, *, num_rows: int = 0, n_ids: int = 0,
+            width: int = 1) -> str:
+    """Pick the leg ("pallas" | "opt" | "ref") for one seam.
+
+    ``off`` is the kill switch: the seed path everywhere, bit-for-bit.
+    ``xla`` forces the optimized XLA legs even on TPU. ``pallas`` and
+    ``auto`` take the compiled kernel where :func:`supported` allows and
+    degrade to the optimized XLA leg elsewhere — except the plan seam,
+    where tables above ``PLAN_COUNT_MAX_ROWS`` keep the sort-based
+    reference build (the vocab-shaped counting pass would scale with rows;
+    the sort does not)."""
+    if mode not in MODES:
+        raise ValueError(f"embedding_kernels must be one of {MODES}, "
+                         f"got {mode!r}")
+    if mode == "off":
+        return "ref"
+    if kernel == "plan" and num_rows > PLAN_COUNT_MAX_ROWS:
+        return "ref"
+    if mode in ("auto", "pallas") and supported(
+            kernel, num_rows=num_rows, n_ids=n_ids, width=width):
+        return "pallas"
+    return "opt"
+
+
+# ---------------------------------------------------------------------------
+# Kernel 1: device-side plan build (unique + remap, static shapes)
+# ---------------------------------------------------------------------------
+# Same counting formulation as make_plan_counting, as one kernel: presence
+# marks and the prefix sum stay in VMEM instead of round-tripping three
+# HBM-shaped intermediates through XLA op boundaries. Outputs are
+# PlanEntry-compatible: uids/inv bit-identical to jnp.unique(size=N,
+# fill_value=num_rows), plus the touched/rank writeback companions.
+
+
+def _plan_kernel(ids_ref, uids_ref, inv_ref, touched_ref, rank_ref,
+                 counts_ref):
+    # counts_ref is a [1, rows+1] work buffer (an extra kernel output — the
+    # wrapper discards it; using an output instead of pltpu scratch keeps
+    # the body identical between interpret and compiled modes).
+    n = ids_ref.shape[1]
+    rows = touched_ref.shape[1]
+    counts_ref[...] = jnp.zeros_like(counts_ref)
+
+    def mark(i, _):
+        counts_ref[0, ids_ref[0, i]] = 1
+        return 0
+
+    jax.lax.fori_loop(0, n, mark, 0)
+    csum = jnp.cumsum(counts_ref[...], axis=1)          # [1, rows+1]
+    rank = csum - counts_ref[...]                        # exclusive rank
+    touched_ref[...] = counts_ref[0, :rows].reshape(1, rows) > 0
+    # rank spans the FULL [rows+1] id space: the OOB fill id (= rows) must
+    # be remappable too (masked hashed positions carry it).
+    rank_ref[...] = rank.astype(jnp.int32)
+    # uids: compact the present row ids into their rank slot; unfilled
+    # slots keep the OOB fill id (= rows), matching unique's fill_value.
+    uids_ref[...] = jnp.full_like(uids_ref, rows)
+
+    def emit(r, _):
+        @pl.when(counts_ref[0, r] > 0)
+        def _():
+            uids_ref[0, rank_ref[0, r]] = r
+        return 0
+
+    jax.lax.fori_loop(0, rows, emit, 0)
+
+    def remap(i, _):
+        inv_ref[0, i] = rank_ref[0, ids_ref[0, i]]
+        return 0
+
+    jax.lax.fori_loop(0, n, remap, 0)
+
+
+def plan_build_pallas(ids: jax.Array, num_rows: int,
+                      mask: Optional[jax.Array] = None,
+                      interpret: bool = False) -> emb_ops.PlanEntry:
+    """Device-side plan build as ONE kernel launch. ``interpret=True`` runs
+    the identical body on CPU (tests); the compiled path is TPU-only
+    behind ``supported("plan", ...)``.
+
+    NOTE: rank[r] for rows past the last touched id equals U (one past the
+    uid slots) inside the kernel's scratch; the emitted ``rank`` output is
+    only read under ``touched`` downstream, same contract as the XLA leg.
+    """
+    flat = ids.reshape(1, -1).astype(jnp.int32)
+    n = flat.shape[1]
+    uids, inv, touched, rank, _counts = pl.pallas_call(
+        _plan_kernel,
+        out_shape=[
+            jax.ShapeDtypeStruct((1, n), jnp.int32),
+            jax.ShapeDtypeStruct((1, n), jnp.int32),
+            jax.ShapeDtypeStruct((1, num_rows), jnp.bool_),
+            jax.ShapeDtypeStruct((1, num_rows + 1), jnp.int32),
+            jax.ShapeDtypeStruct((1, num_rows + 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(flat)
+    return emb_ops.PlanEntry(
+        uids=uids[0], inv=inv[0].reshape(ids.shape), mask=mask,
+        num_rows=num_rows, touched=touched[0], rank=rank[0, :num_rows])
+
+
+def plan_build(ids: jax.Array, num_rows: int,
+               mask: Optional[jax.Array] = None, *,
+               mode: str = "auto") -> emb_ops.PlanEntry:
+    """Build a sparse-update plan through the selected leg. All legs emit
+    bit-identical uids/inv; the counting legs additionally carry the
+    touched/rank select-writeback companions."""
+    leg = resolve(mode, "plan", num_rows=num_rows, n_ids=ids.size)
+    if leg == "pallas":
+        return plan_build_pallas(ids, num_rows, mask)
+    if leg == "opt":
+        return emb_ops.make_plan_counting(ids, num_rows, mask)
+    return emb_ops.make_plan(ids, num_rows, mask)
+
+
+# ---------------------------------------------------------------------------
+# Kernel 2: fused gather forward + segment-sum backward (custom VJP)
+# ---------------------------------------------------------------------------
+# Forward: out[p] = rows[inv[p]] for every batch position p. Backward: the
+# batch-sized segment-sum d_rows[u] = sum_{p: inv[p]=u} g[p] — the exact
+# transpose XLA's AD emits for the gather, as one accumulate kernel instead
+# of a gather + scatter-add pair per embedding name. The XLA legs stay
+# plain ``jnp.take`` (AD supplies the identical scatter-add); the fusion
+# win there is structural: the trainer concatenates every embedding name's
+# rows into ONE [U, D] leaf so a single take/scatter-add pair serves all
+# names (train.loop).
+
+
+def _take_fwd_kernel(rows_ref, inv_ref, out_ref):
+    n = inv_ref.shape[1]
+
+    def body(i, _):
+        out_ref[i, :] = rows_ref[inv_ref[0, i], :]
+        return 0
+
+    jax.lax.fori_loop(0, n, body, 0)
+
+
+def _take_bwd_kernel(g_ref, inv_ref, out_ref):
+    n = inv_ref.shape[1]
+    out_ref[...] = jnp.zeros_like(out_ref)
+
+    def body(i, _):
+        out_ref[inv_ref[0, i], :] += g_ref[i, :]
+        return 0
+
+    jax.lax.fori_loop(0, n, body, 0)
+
+
+def _take_pallas_fwd(rows: jax.Array, inv2: jax.Array,
+                     interpret: bool) -> jax.Array:
+    n = inv2.shape[1]
+    return pl.pallas_call(
+        _take_fwd_kernel,
+        out_shape=jax.ShapeDtypeStruct((n, rows.shape[1]), rows.dtype),
+        interpret=interpret,
+    )(rows, inv2)
+
+
+def _take_pallas_bwd(g: jax.Array, inv2: jax.Array, u: int,
+                     interpret: bool) -> jax.Array:
+    return pl.pallas_call(
+        _take_bwd_kernel,
+        out_shape=jax.ShapeDtypeStruct((u, g.shape[1]), g.dtype),
+        interpret=interpret,
+    )(g, inv2)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def take_rows_pallas(rows: jax.Array, inv: jax.Array,
+                     interpret: bool = False) -> jax.Array:
+    """rows[inv] with a hand-written segment-sum VJP, both as Pallas
+    kernels. rows: [U, D]; inv: int32 [...] -> out [..., D]."""
+    inv2 = inv.reshape(1, -1).astype(jnp.int32)
+    out = _take_pallas_fwd(rows, inv2, interpret)
+    return out.reshape(inv.shape + rows.shape[1:])
+
+
+def _take_rows_fwd(rows, inv, interpret):
+    return take_rows_pallas(rows, inv, interpret), (inv, rows.shape[0])
+
+
+def _take_rows_bwd(interpret, res, g):
+    inv, u = res
+    g2 = g.reshape(-1, g.shape[-1])
+    inv2 = inv.reshape(1, -1).astype(jnp.int32)
+    return _take_pallas_bwd(g2, inv2, u, interpret), None
+
+
+take_rows_pallas.defvjp(_take_rows_fwd, _take_rows_bwd)
+
+
+def take_rows(rows: jax.Array, inv: jax.Array, *,
+              mode: str = "auto") -> jax.Array:
+    """Positionwise view of gathered rows, leg-selected. The XLA legs are
+    ``jnp.take`` — its AD transpose IS the batch-sized segment-sum — so
+    every leg produces bit-identical values and cotangents."""
+    leg = resolve(mode, "take", n_ids=inv.size,
+                  width=int(rows.shape[-1]) if rows.ndim > 1 else 1)
+    if leg == "pallas":
+        return take_rows_pallas(rows, inv)
+    return jnp.take(rows, inv, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Kernel 3: fused install/evict scatter (tiered cache transaction)
+# ---------------------------------------------------------------------------
+# One launch installs a transaction's weight rows AND the three lazy-Adam
+# companions (m, v, tau) at their hot-cache slots; OOB slot ids (the pow2
+# padding) are dropped. The XLA "opt" leg fuses the same four scatters into
+# one jit program (one dispatch instead of four); "ref" is the seed
+# per-array ``_jit_install``.
+
+
+def _install_kernel(w_ref, m_ref, v_ref, tau_ref, slots_ref,
+                    wv_ref, mv_ref, vv_ref, tv_ref,
+                    ow_ref, om_ref, ov_ref, otau_ref):
+    rows = w_ref.shape[0]
+    s = slots_ref.shape[1]
+    ow_ref[...] = w_ref[...]
+    om_ref[...] = m_ref[...]
+    ov_ref[...] = v_ref[...]
+    otau_ref[...] = tau_ref[...]
+
+    def body(i, _):
+        slot = slots_ref[0, i]
+
+        @pl.when(slot < rows)
+        def _():
+            ow_ref[slot, :] = wv_ref[i, :]
+            om_ref[slot, :] = mv_ref[i, :]
+            ov_ref[slot, :] = vv_ref[i, :]
+            otau_ref[0, slot] = tv_ref[0, i]
+        return 0
+
+    jax.lax.fori_loop(0, s, body, 0)
+
+
+def install_pallas(w, m, v, tau, slots, wv, mv, vv, tv,
+                   interpret: bool = False):
+    """One cache transaction as ONE kernel: returns (w, m, v, tau) with
+    ``slots`` rows replaced by the fetched values; OOB slots dropped."""
+    slots2 = slots.reshape(1, -1).astype(jnp.int32)
+    tau2 = tau.reshape(1, -1)
+    tv2 = tv.reshape(1, -1)
+    ow, om, ov, otau = pl.pallas_call(
+        _install_kernel,
+        out_shape=[
+            jax.ShapeDtypeStruct(w.shape, w.dtype),
+            jax.ShapeDtypeStruct(m.shape, m.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+            jax.ShapeDtypeStruct(tau2.shape, tau.dtype),
+        ],
+        interpret=interpret,
+    )(w, m, v, tau2, slots2, wv, mv, vv, tv2)
+    return ow, om, ov, otau.reshape(tau.shape)
+
+
+@functools.partial(jax.jit, donate_argnums=())
+def _install_fused_xla(w, m, v, tau, slots, wv, mv, vv, tv):
+    """The XLA "opt" install leg: all four scatters in one jit program —
+    one dispatch per transaction instead of four. Slot list is pow2-padded
+    by the caller (data.hot_cold), so the compile cache stays
+    O(log max_group) per table shape."""
+    return (w.at[slots].set(wv), m.at[slots].set(mv),
+            v.at[slots].set(vv), tau.at[slots].set(tv))
+
+
+def install_rows(w, m, v, tau, slots, wv, mv, vv, tv, *, mode: str = "auto"):
+    """Leg-selected cache install. All legs are element-identical: the same
+    rows get the same values, OOB (padding) slots are dropped."""
+    leg = resolve(mode, "install", n_ids=int(slots.shape[0]),
+                  width=int(w.shape[-1]) if w.ndim > 1 else 1)
+    if leg == "pallas":
+        return install_pallas(w, m, v, tau, slots, wv, mv, vv, tv)
+    if leg == "opt":
+        return _install_fused_xla(w, m, v, tau, slots, wv, mv, vv, tv)
+    return None  # ref: caller keeps its per-array scatter path
+
+
+def install_cache_size() -> int:
+    """Compiled-variant count of the fused install program (the compile-
+    cache bound test asserts the pow2 ladder keeps this O(log max))."""
+    return _install_fused_xla._cache_size()
+
+
+def install_cache_clear() -> None:
+    _install_fused_xla.clear_cache()
+
+
+# ---------------------------------------------------------------------------
+# NumPy oracle (tests)
+# ---------------------------------------------------------------------------
+
+
+def reference_plan_numpy(ids, num_rows):
+    """np.unique-based oracle for the plan builders (tests)."""
+    import numpy as np
+    flat = np.asarray(ids).reshape(-1).astype(np.int64)
+    uniq, inv = np.unique(flat, return_inverse=True)
+    n = flat.size
+    uids = np.full((n,), num_rows, np.int32)
+    uids[: uniq.size] = uniq
+    touched = np.zeros((num_rows,), bool)
+    touched[uniq[uniq < num_rows]] = True
+    rank = np.zeros((num_rows,), np.int32)
+    rank[uniq[uniq < num_rows]] = np.arange(uniq.size)[uniq < num_rows]
+    return (uids, inv.reshape(np.asarray(ids).shape).astype(np.int32),
+            touched, rank)

@@ -47,7 +47,7 @@ except ImportError:  # jax < 0.5: experimental location, check_rep kwarg
 from ..config import Config
 from ..models import get_model
 from ..obs import trace as trace_lib
-from ..ops import embedding as emb_ops
+from ..ops import pallas_embedding as pemb
 from ..parallel import mesh as mesh_lib
 from ..utils import logging as ulog
 from ..utils import profiling as prof_lib
@@ -233,6 +233,10 @@ class Trainer:
             self.sparse_embed = False
         self._embed_names = tuple(self.model.embedding_param_names())
         self._sparse_lr = cfg.learning_rate  # world == 1 on the sparse path
+        # Kernel-leg selection for the sparse embedding plane (see
+        # ops.pallas_embedding): "off" is the kill switch that also
+        # disables the fused one-leaf backward below.
+        self._emb_kernels = cfg.embedding_kernels
         # Hot/cold tiered embedding storage (requires the sparse path).
         self._tier: Optional[Any] = None
         if cfg.embedding_tiering == "hot_cold":
@@ -428,6 +432,118 @@ class Trainer:
             model_state=new_mstate)
         return new_state, {"loss": xent + l2, "xent": xent}
 
+    # -- sparse-plane helpers (fused vocab-space backward) --------------
+    def _use_fused_backward(self) -> bool:
+        """The fused formulation differentiates the [B, F, D] BATCH VIEWS
+        of each embedding table (a direct gather — no plan, no inverse
+        remap), accumulates all names' cotangents plus an occupancy column
+        in ONE table-shaped scatter-add, and applies lazy Adam as a masked
+        table-space sweep (optimizers.sparse_adam_masked). Structurally
+        that is the dense step's cost profile with lazy-Adam semantics —
+        it needs the monolithic layout, same-height 2-D tables, and a
+        table small enough to sweep; ``--embedding_kernels off`` is the
+        kill switch back to the plan-based seed formulation."""
+        return self._emb_kernels != "off" and not self.model.emb.hashed
+
+    def _fused_tables_ok(self, tabs: Dict[str, jax.Array]) -> bool:
+        heights = {t.shape[0] for t in tabs.values()}
+        return (len(heights) == 1
+                and all(t.ndim in (1, 2) for t in tabs.values())
+                and heights.pop() <= pemb.PLAN_COUNT_MAX_ROWS)
+
+    def _fused_grad_ext(self, tabs, ids, g_views):
+        """ONE table-shaped scatter-add for the whole embedding plane:
+        column 0 accumulates an occupancy count (touch marks — exact
+        integers in f32 up to 2^24 positions; a separate boolean
+        scatter-set benches ~2 ms SLOWER than riding in the one scatter),
+        the rest accumulate every name's per-position cotangents.
+        Per-(row, column) addition order is batch-position order — the
+        same order XLA's per-name gather transpose uses — so the per-name
+        gradient slices are bit-identical to the seed path's
+        segment-sums."""
+        flat = ids.reshape(-1)
+        n_pos = flat.shape[0]
+        rows = next(iter(tabs.values())).shape[0]
+        cols = [jnp.ones((n_pos, 1), jnp.float32)]
+        cols += [g_views[n].reshape(n_pos, -1).astype(jnp.float32)
+                 for n in self._embed_names]
+        gcat = jnp.concatenate(cols, axis=1)
+        gext = jnp.zeros((rows, gcat.shape[1]), jnp.float32)
+        return gext.at[flat].add(gcat)
+
+    def _fused_apply(self, state: TrainState, tabs, gext, count):
+        """Masked lazy-Adam sweep per name over the gradient columns of
+        ``gext`` (+ the touched-rows-only L2 term, added here exactly as
+        AD adds it on the seed path). Returns (new_params_embed,
+        new_embed_opt, l2_value)."""
+        touched = gext[:, 0] > 0
+        opt_embed = state.opt_state["embed"]
+        emb = self.model.emb
+        l2_reg = self.cfg.l2_reg
+        new_params_embed: Dict[str, Any] = {}
+        new_embed: Dict[str, Any] = {}
+        l2 = jnp.zeros((), jnp.float32)
+        # tau is identical across tables (same touched set every step), so
+        # the lazy-decay pows — the sweep's hot spot — are computed once
+        # and shared by every table (see sparse_adam_masked's decay note).
+        # exp2 formulation: benches ~11x faster than jnp.power on XLA:CPU
+        # (pow lowers to a libm call) at ~1 ULP from pow — inside the
+        # tolerance already pinned for this leg (sparse_adam_masked doc).
+        tau = opt_embed[self._embed_names[0]][emb.MONO].tau
+        idle = (count - tau).astype(jnp.float32)
+        decay = jax.lax.optimization_barrier(
+            (jnp.exp2(idle * np.float32(np.log2(0.9))),
+             jnp.exp2(idle * np.float32(np.log2(0.999)))))
+        o = 1
+        for name in self._embed_names:
+            tab = tabs[name]
+            d = 1 if tab.ndim == 1 else tab.shape[-1]
+            g_eff = gext[:, o:o + d].reshape(tab.shape)
+            if l2_reg:
+                g_eff = g_eff + l2_reg * tab.astype(jnp.float32)
+            o += d
+            new_tab, new_oe = opt_lib.sparse_adam_masked(
+                tab, g_eff, touched, opt_embed[name][emb.MONO], count,
+                lr=self._sparse_lr, decay=decay)
+            new_params_embed[name] = new_tab
+            new_embed[name] = {emb.MONO: new_oe}
+            if l2_reg:
+                sq = jnp.square(tab.astype(jnp.float32))
+                keep = touched.reshape(touched.shape + (1,) * (sq.ndim - 1))
+                l2 = l2 + 0.5 * jnp.sum(
+                    jnp.where(keep, sq, jnp.zeros((), sq.dtype)))
+        return new_params_embed, new_embed, l2_reg * l2
+
+    def _sparse_apply(self, state: TrainState, plan, rows0, g_rows, count):
+        """Lazy-Adam apply + writeback for every (name, table): returns
+        ({name: new_entry_params}, {name: new_opt_tables}).
+
+        The counting plans' select-writeback companions are STRIPPED here:
+        a vocab-shaped ``where`` in the update graph perturbs XLA:CPU's
+        fusion of the model backward (~1 ULP cotangent drift), breaking
+        the kill-switch bit-parity pin. The scatter writeback is
+        bit-exact, so the trainer always takes it; the select leg stays
+        available to the A/B bench through ``ops.embedding.scatter_rows``
+        directly (recorded as a parity loss in EMBED_r02.json)."""
+        plan = {key: e._replace(touched=None, rank=None)
+                for key, e in plan.items()}
+        emb = self.model.emb
+        opt_embed = state.opt_state["embed"]
+        new_params_embed: Dict[str, Any] = {}
+        new_embed: Dict[str, Any] = {}
+        for name in self._embed_names:
+            tabs = emb.tables(state.params[name])
+            new_tabs: Dict[str, jax.Array] = {}
+            new_opt_t: Dict[str, Any] = {}
+            for key, e in plan.items():
+                new_tabs[key], new_opt_t[key] = opt_lib.sparse_apply_rows(
+                    rows0[name][key], g_rows[name][key], e,
+                    opt_embed[name][key], count, lr=self._sparse_lr,
+                    table=tabs[key])
+            new_params_embed[name] = emb.from_tables(new_tabs)
+            new_embed[name] = new_opt_t
+        return new_params_embed, new_embed
+
     def _sparse_step_impl(self, state: TrainState, batch
                           ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
         """One sparse-update optimizer step (single-device path).
@@ -438,59 +554,81 @@ class Trainer:
         segment-sum scatter-add instead of a [vocab, ...] cotangent, and
         lazy timestamped Adam (optimizers.sparse_adam_rows) touches only
         those rows. Per-step cost scales with unique-ids-per-batch, never
-        with vocab size (EMBED_r01.json pins the scaling curve)."""
+        with vocab size (EMBED_r02.json pins the scaling curve).
+
+        With the embedding kernels enabled (default) the monolithic layout
+        takes the FUSED vocab-space formulation: the [B, F, D] batch views
+        are the gradient leaves (no dedup plan at all), every name's
+        cotangents land in one table-shaped scatter-add alongside an
+        occupancy column, and lazy Adam runs as a masked table sweep —
+        at the dense step's cost profile. Gradients are bit-identical to
+        the seed formulation; the Adam tail rounds 1–2 ULP apart between
+        the row-space and table-sweep programs (see sparse_adam_masked),
+        so the kill-switch parity test pins a tight tolerance there and
+        bit equality everywhere else."""
         emb = self.model.emb
         rng = jax.random.fold_in(state.rng, state.step)
-        plan = emb.sparse_plan(batch["feat_ids"])
-        rows0 = {n: emb.gather_rows(state.params[n], plan)
-                 for n in self._embed_names}
+        tabs = {n: state.params[n] for n in self._embed_names}
         rest0 = {k: v for k, v in state.params.items()
                  if k not in self._embed_names}
+        fused = self._use_fused_backward() and self._fused_tables_ok(tabs)
 
-        def loss_fn(diff):
-            rows, rest = diff
-            params = {**rest,
-                      **{n: state.params[n] for n in self._embed_names}}
-            logits, new_mstate = self.model.apply(
-                params, state.model_state, batch["feat_ids"],
-                batch["feat_vals"], train=True, rng=rng,
-                shard_axis=None, data_axis=None,
-                emb_rows=rows, emb_plan=plan)
-            labels = self._batch_labels(batch)
-            xent = jnp.mean(self._per_example_loss(logits, labels))
-            # Touched-rows-only L2 (deliberate deviation from dense L2 —
-            # idle rows do not decay between touches; TUNING §2.11).
-            l2 = self.model.l2_loss(params, emb_rows=rows, emb_plan=plan)
-            return xent + l2, (xent, l2, new_mstate)
+        if fused:
+            ids = batch["feat_ids"]
+            views0 = {n: jnp.take(tabs[n], ids, axis=0)
+                      for n in self._embed_names}
 
-        (_, (xent, l2, new_mstate)), (g_rows, g_rest) = jax.value_and_grad(
-            loss_fn, has_aux=True)((rows0, rest0))
+            def loss_fn(diff):
+                views, rest = diff
+                params = {**rest, **tabs}
+                logits, new_mstate = self.model.apply(
+                    params, state.model_state, batch["feat_ids"],
+                    batch["feat_vals"], train=True, rng=rng,
+                    shard_axis=None, data_axis=None,
+                    emb_rows={n: {emb.MONO: views[n]}
+                              for n in self._embed_names}, emb_plan=None)
+                labels = self._batch_labels(batch)
+                xent = jnp.mean(self._per_example_loss(logits, labels))
+                return xent, (xent, new_mstate)
+
+            (_, (xent, new_mstate)), (g_views, g_rest) = (
+                jax.value_and_grad(loss_fn, has_aux=True)((views0, rest0)))
+            gext = self._fused_grad_ext(tabs, ids, g_views)
+        else:
+            plan = emb.sparse_plan(batch["feat_ids"])
+            rows0 = {n: emb.gather_rows(state.params[n], plan)
+                     for n in self._embed_names}
+
+            def loss_fn(diff):
+                rows, rest = diff
+                params = {**rest, **tabs}
+                logits, new_mstate = self.model.apply(
+                    params, state.model_state, batch["feat_ids"],
+                    batch["feat_vals"], train=True, rng=rng,
+                    shard_axis=None, data_axis=None,
+                    emb_rows=rows, emb_plan=plan)
+                labels = self._batch_labels(batch)
+                xent = jnp.mean(self._per_example_loss(logits, labels))
+                # Touched-rows-only L2 (deliberate deviation from dense L2
+                # — idle rows do not decay between touches; TUNING §2.11).
+                l2 = self.model.l2_loss(params, emb_rows=rows, emb_plan=plan)
+                return xent + l2, (xent, l2, new_mstate)
+
+            (_, (xent, l2, new_mstate)), (g_rows, g_rest) = (
+                jax.value_and_grad(loss_fn, has_aux=True)((rows0, rest0)))
 
         opt = state.opt_state
         upd_rest, new_base = self.tx.update(g_rest, opt["base"], rest0)
         new_rest = optax.apply_updates(rest0, upd_rest)
         count = opt["count"] + 1
         new_params = dict(new_rest)
-        new_embed = {}
-        for name in self._embed_names:
-            tabs = emb.tables(state.params[name])
-            new_tabs: Dict[str, jax.Array] = {}
-            new_opt_t: Dict[str, Any] = {}
-            for key, e in plan.items():
-                oe = opt["embed"][name][key]
-                new_rows, new_m, new_v = opt_lib.sparse_adam_rows(
-                    rows0[name][key], g_rows[name][key],
-                    emb_ops.gather_rows(oe.m, e),
-                    emb_ops.gather_rows(oe.v, e),
-                    emb_ops.gather_rows(oe.tau, e),
-                    count, lr=self._sparse_lr)
-                new_tabs[key] = emb_ops.scatter_rows(tabs[key], e, new_rows)
-                new_opt_t[key] = opt_lib.EmbedAdamEntry(
-                    m=emb_ops.scatter_rows(oe.m, e, new_m),
-                    v=emb_ops.scatter_rows(oe.v, e, new_v),
-                    tau=oe.tau.at[e.uids].set(count))
-            new_params[name] = emb.from_tables(new_tabs)
-            new_embed[name] = new_opt_t
+        if fused:
+            emb_params, new_embed, l2 = self._fused_apply(
+                state, tabs, gext, count)
+        else:
+            emb_params, new_embed = self._sparse_apply(
+                state, plan, rows0, g_rows, count)
+        new_params.update(emb_params)
         new_opt = {"base": new_base, "embed": new_embed, "count": count}
         new_state = state.replace(
             step=state.step + 1, params=new_params, opt_state=new_opt,
@@ -580,75 +718,105 @@ class Trainer:
         emb = self.model.emb
         a, bsz = batches["feat_ids"].shape[:2]
         base_rng = jax.random.fold_in(state.rng, state.step)
-        ids_flat = batches["feat_ids"].reshape(
-            (a * bsz,) + batches["feat_ids"].shape[2:])
-        plan = emb.sparse_plan(ids_flat)
-        # Per-microbatch plan views: merged uids, inverse index (and the
-        # hashed-mode position mask) sliced back to [B, F] for the scan.
-        inv_stack = {key: e.inv.reshape((a, bsz) + e.inv.shape[1:])
-                     for key, e in plan.items()}
-        mask_stack = {key: e.mask.reshape((a, bsz) + e.mask.shape[1:])
-                      for key, e in plan.items() if e.mask is not None}
-        rows0 = {n: emb.gather_rows(state.params[n], plan)
-                 for n in self._embed_names}
+        tabs = {n: state.params[n] for n in self._embed_names}
         rest0 = {k: v for k, v in state.params.items()
                  if k not in self._embed_names}
+        fused = self._use_fused_backward() and self._fused_tables_ok(tabs)
 
-        def loss_fn(diff):
-            rows, rest = diff
-            params = {**rest,
-                      **{n: state.params[n] for n in self._embed_names}}
+        if fused:
+            # Fused vocab-space formulation over the whole group: the
+            # [a, B, F, D] stacked views are the leaves; the scan slices
+            # one microbatch's view per iteration and AD stacks the
+            # per-microbatch cotangents back into [a, B, F, D] — flattened
+            # into ONE table-shaped scatter-add below (group-position
+            # order == the merged plan's segment-sum order, bit-for-bit).
+            ids = batches["feat_ids"]
+            views0 = {n: jnp.take(tabs[n], ids, axis=0)
+                      for n in self._embed_names}
 
-            def micro(carry, inp):
-                mstate, xent_sum = carry
-                i, batch, inv_i, mask_i = inp
-                plan_i = {key: e._replace(inv=inv_i[key],
-                                          mask=mask_i.get(key))
-                          for key, e in plan.items()}
-                rng = jax.random.fold_in(base_rng, i)
-                logits, new_mstate = self.model.apply(
-                    params, mstate, batch["feat_ids"], batch["feat_vals"],
-                    train=True, rng=rng, shard_axis=None, data_axis=None,
-                    emb_rows=rows, emb_plan=plan_i)
-                labels = self._batch_labels(batch)
-                xent = jnp.mean(self._per_example_loss(logits, labels))
-                return (new_mstate, xent_sum + xent), None
+            def loss_fn(diff):
+                views, rest = diff
+                params = {**rest, **tabs}
 
-            (new_mstate, xent_sum), _ = jax.lax.scan(
-                micro, (state.model_state, jnp.zeros((), jnp.float32)),
-                (jnp.arange(a), batches, inv_stack, mask_stack))
-            xent = xent_sum / a
-            l2 = self.model.l2_loss(params, emb_rows=rows, emb_plan=plan)
-            return xent + l2, (xent, l2, new_mstate)
+                def micro(carry, inp):
+                    mstate, xent_sum = carry
+                    i, batch, views_i = inp
+                    rng = jax.random.fold_in(base_rng, i)
+                    logits, new_mstate = self.model.apply(
+                        params, mstate, batch["feat_ids"],
+                        batch["feat_vals"], train=True, rng=rng,
+                        shard_axis=None, data_axis=None,
+                        emb_rows={n: {emb.MONO: views_i[n]}
+                                  for n in self._embed_names},
+                        emb_plan=None)
+                    labels = self._batch_labels(batch)
+                    xent = jnp.mean(self._per_example_loss(logits, labels))
+                    return (new_mstate, xent_sum + xent), None
 
-        (_, (xent, l2, new_mstate)), (g_rows, g_rest) = jax.value_and_grad(
-            loss_fn, has_aux=True)((rows0, rest0))
+                (new_mstate, xent_sum), _ = jax.lax.scan(
+                    micro, (state.model_state, jnp.zeros((), jnp.float32)),
+                    (jnp.arange(a), batches, views))
+                xent = xent_sum / a
+                return xent, (xent, new_mstate)
+
+            (_, (xent, new_mstate)), (g_views, g_rest) = (
+                jax.value_and_grad(loss_fn, has_aux=True)((views0, rest0)))
+            gext = self._fused_grad_ext(tabs, ids, g_views)
+        else:
+            ids_flat = batches["feat_ids"].reshape(
+                (a * bsz,) + batches["feat_ids"].shape[2:])
+            plan = emb.sparse_plan(ids_flat)
+            # Per-microbatch plan views: merged uids, inverse index (and
+            # the hashed-mode position mask) sliced back to [B, F].
+            inv_stack = {key: e.inv.reshape((a, bsz) + e.inv.shape[1:])
+                         for key, e in plan.items()}
+            mask_stack = {key: e.mask.reshape((a, bsz) + e.mask.shape[1:])
+                          for key, e in plan.items() if e.mask is not None}
+            rows0 = {n: emb.gather_rows(state.params[n], plan)
+                     for n in self._embed_names}
+
+            def loss_fn(diff):
+                rows, rest = diff
+                params = {**rest, **tabs}
+
+                def micro(carry, inp):
+                    mstate, xent_sum = carry
+                    i, batch, inv_i, mask_i = inp
+                    plan_i = {key: e._replace(inv=inv_i[key],
+                                              mask=mask_i.get(key))
+                              for key, e in plan.items()}
+                    rng = jax.random.fold_in(base_rng, i)
+                    logits, new_mstate = self.model.apply(
+                        params, mstate, batch["feat_ids"],
+                        batch["feat_vals"], train=True, rng=rng,
+                        shard_axis=None, data_axis=None,
+                        emb_rows=rows, emb_plan=plan_i)
+                    labels = self._batch_labels(batch)
+                    xent = jnp.mean(self._per_example_loss(logits, labels))
+                    return (new_mstate, xent_sum + xent), None
+
+                (new_mstate, xent_sum), _ = jax.lax.scan(
+                    micro, (state.model_state, jnp.zeros((), jnp.float32)),
+                    (jnp.arange(a), batches, inv_stack, mask_stack))
+                xent = xent_sum / a
+                l2 = self.model.l2_loss(params, emb_rows=rows, emb_plan=plan)
+                return xent + l2, (xent, l2, new_mstate)
+
+            (_, (xent, l2, new_mstate)), (g_rows, g_rest) = (
+                jax.value_and_grad(loss_fn, has_aux=True)((rows0, rest0)))
 
         opt = state.opt_state
         upd_rest, new_base = self.tx.update(g_rest, opt["base"], rest0)
         new_rest = optax.apply_updates(rest0, upd_rest)
         count = opt["count"] + 1
         new_params = dict(new_rest)
-        new_embed = {}
-        for name in self._embed_names:
-            tabs = emb.tables(state.params[name])
-            new_tabs: Dict[str, jax.Array] = {}
-            new_opt_t: Dict[str, Any] = {}
-            for key, e in plan.items():
-                oe = opt["embed"][name][key]
-                new_rows, new_m, new_v = opt_lib.sparse_adam_rows(
-                    rows0[name][key], g_rows[name][key],
-                    emb_ops.gather_rows(oe.m, e),
-                    emb_ops.gather_rows(oe.v, e),
-                    emb_ops.gather_rows(oe.tau, e),
-                    count, lr=self._sparse_lr)
-                new_tabs[key] = emb_ops.scatter_rows(tabs[key], e, new_rows)
-                new_opt_t[key] = opt_lib.EmbedAdamEntry(
-                    m=emb_ops.scatter_rows(oe.m, e, new_m),
-                    v=emb_ops.scatter_rows(oe.v, e, new_v),
-                    tau=oe.tau.at[e.uids].set(count))
-            new_params[name] = emb.from_tables(new_tabs)
-            new_embed[name] = new_opt_t
+        if fused:
+            emb_params, new_embed, l2 = self._fused_apply(
+                state, tabs, gext, count)
+        else:
+            emb_params, new_embed = self._sparse_apply(
+                state, plan, rows0, g_rows, count)
+        new_params.update(emb_params)
         new_opt = {"base": new_base, "embed": new_embed, "count": count}
         new_state = state.replace(
             step=state.step + a, params=new_params, opt_state=new_opt,

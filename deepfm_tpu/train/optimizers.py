@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import optax
 
 from ..config import Config
+from ..ops import embedding as emb_ops
 
 
 class FtrlState(NamedTuple):
@@ -154,6 +155,97 @@ def sparse_adam_rows(
     v_hat = v / (1.0 - jnp.power(b2, cnt))
     new_rows = rows0.astype(jnp.float32) - lr * m_hat / (jnp.sqrt(v_hat) + eps)
     return new_rows.astype(rows0.dtype), m, v
+
+
+def sparse_adam_masked(
+    table: jax.Array,      # f32 [R, ...] full table (pre-update values)
+    g_rows: jax.Array,     # f32 [R, ...] summed per-row gradient (junk on
+                           #              untouched rows — masked out below)
+    touched: jax.Array,    # bool [R]     rows present in this batch
+    oe: EmbedAdamEntry,
+    count: jax.Array,      # int32 []     global step count AFTER this step
+    *,
+    lr: float,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    decay: Optional[tuple] = None,
+):
+    """Lazy Adam as a masked TABLE-SPACE sweep: per-row math identical to
+    :func:`sparse_adam_rows`, applied under ``touched`` with untouched rows
+    keeping their exact bits (a ``where``, not a blend). The sweep costs
+    one elementwise pass over the table — the same shape of work a dense
+    Adam step does — so it beats the gather/apply/scatter round-trip
+    whenever the physical table is small enough to sweep (the monolithic
+    CTR regime; ops.pallas_embedding.PLAN_COUNT_MAX_ROWS bounds it).
+
+    Numerics contract: the MATH matches sparse_adam_rows exactly, but the
+    compiled programs differ in shape ([rows] sweep vs [uids] gather), so
+    XLA:CPU is free to fuse/contract the m_hat / (sqrt(v_hat)+eps) tail
+    differently — in practice a 1–2 ULP divergence per apply from step 2
+    on (step 1 is exact because m=v=0). The trainer's kill-switch parity
+    test therefore pins this leg with a tight tolerance rather than bit
+    equality; ``optimization_barrier`` placements were tried and do not
+    close the gap (XLA duplicates barriered chains per consumer).
+
+    ``decay``: optional precomputed ``(b1^idle, b2^idle)`` pair of [R]
+    arrays. The pows are the sweep's hot spot — left inline, XLA fuses
+    the [R]-shaped pow into the [R, D] elementwise loop and evaluates it
+    D times per row — so the caller computes them ONCE behind an
+    optimization_barrier and shares them across every table of the plane
+    (tau is identical across tables: same touched set every step).
+    Returns ``(new_table, new_EmbedAdamEntry)``."""
+    g = g_rows.astype(jnp.float32)
+    cnt = count.astype(jnp.float32)
+    if decay is None:
+        idle = (count - oe.tau).astype(jnp.float32)  # [R] steps since touch
+        decay = jax.lax.optimization_barrier(
+            (jnp.power(b1, idle), jnp.power(b2, idle)))
+    pw1, pw2 = (d.reshape(d.shape + (1,) * (g.ndim - 1)) for d in decay)
+    m = pw1 * oe.m + (1.0 - b1) * g
+    v = pw2 * oe.v + (1.0 - b2) * jnp.square(g)
+    m_hat = m / (1.0 - jnp.power(b1, cnt))
+    v_hat = v / (1.0 - jnp.power(b2, cnt))
+    new_rows = table.astype(jnp.float32) - lr * m_hat / (jnp.sqrt(v_hat) + eps)
+    keep = touched.reshape(touched.shape + (1,) * (g.ndim - 1))
+    new_table = jnp.where(keep, new_rows.astype(table.dtype), table)
+    new_oe = EmbedAdamEntry(
+        m=jnp.where(keep, m, oe.m),
+        v=jnp.where(keep, v, oe.v),
+        tau=jnp.where(touched, count, oe.tau))
+    return new_table, new_oe
+
+
+def sparse_apply_rows(
+    rows0: jax.Array,            # f32 [U, ...] touched rows (pre-update)
+    g_rows: jax.Array,           # f32 [U, ...] summed per-row gradient
+    entry: emb_ops.PlanEntry,
+    oe: EmbedAdamEntry,
+    count: jax.Array,
+    *,
+    lr: float,
+    table: jax.Array,
+):
+    """One table's full sparse-Adam transaction: gather the lazy slots at
+    the plan's uids, run :func:`sparse_adam_rows`, and write the three
+    updated row sets plus the ``tau`` touch stamps back. Returns
+    ``(new_table, new_entry)``. Shared by both sparse step impls (per-batch
+    and merged-accumulation) so the gather/apply/writeback sequence — and
+    therefore the numerics — exists in exactly one place; the writebacks go
+    through ``scatter_rows``/``set_rows_scalar``, which pick the
+    select-over-ids formulation automatically on counting plans."""
+    new_rows, new_m, new_v = sparse_adam_rows(
+        rows0, g_rows,
+        emb_ops.gather_rows(oe.m, entry),
+        emb_ops.gather_rows(oe.v, entry),
+        emb_ops.gather_rows(oe.tau, entry),
+        count, lr=lr)
+    new_table = emb_ops.scatter_rows(table, entry, new_rows)
+    new_oe = EmbedAdamEntry(
+        m=emb_ops.scatter_rows(oe.m, entry, new_m),
+        v=emb_ops.scatter_rows(oe.v, entry, new_v),
+        tau=emb_ops.set_rows_scalar(oe.tau, entry, count))
+    return new_table, new_oe
 
 
 def build_optimizer(cfg: Config, *, world_size: int = 1) -> optax.GradientTransformation:

@@ -390,15 +390,23 @@ def _trace_correlation(doc):
         elif ev.get("name") in ("publish.stage", "publish.rename") \
                 and "version" in args:
             publishes.append((t0, t1, int(args["version"]), ev["name"]))
+    first = None
     for s0, s1, mstep, sargs in serves:
         for p0, p1, ver, pname in publishes:
             if ver > mstep and p0 < s1 and s0 < p1:
-                return {"serve_model_step": mstep,
-                        "publish_version": ver,
-                        "publish_span": pname,
-                        "sample_trace_ids":
-                            list(sargs.get("trace_ids", []))[:4]}
-    return None
+                found = {"serve_model_step": mstep,
+                         "publish_version": ver,
+                         "publish_span": pname,
+                         "sample_trace_ids":
+                             list(sargs.get("trace_ids", []))[:4]}
+                # Prefer an overlapping flush that carries request trace
+                # ids (some flushes legitimately have none — warmup or
+                # untagged clients); which one overlaps first is timing
+                # weather, and the evidence wants the ids.
+                if found["sample_trace_ids"]:
+                    return found
+                first = first or found
+    return first
 
 
 def _run_core(workdir, *, mode, seed, pace, say, trace="off", tb_dir=""):
@@ -536,7 +544,11 @@ def _run_core(workdir, *, mode, seed, pace, say, trace="off", tb_dir=""):
             return
         last_tail[0] = time.monotonic()
         try:
-            engine.predict(tail_ids, tail_vals, timeout=60)
+            # Tail requests are real requests: stamp them too, so every
+            # flush the correlation evidence might land on carries ids.
+            engine.predict(tail_ids, tail_vals, timeout=60,
+                           trace_id=(trace_lib.new_trace_id()
+                                     if trace != "off" else None))
         except Exception as e:  # noqa: BLE001 — the loss gate
             failures.append(f"tail: {e!r}")
 

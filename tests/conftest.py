@@ -81,6 +81,12 @@ def pytest_configure(config):
         "overload: overload-plane tests (SLO-aware admission/shedding, "
         "request hedging, degradation ladder, Zipf flood traffic); the "
         "full flood sweep is also slow")
+    config.addinivalue_line(
+        "markers",
+        "pallas: embedding-plane Pallas kernel tests (device-side plan "
+        "build, fused gather/segment-sum backward, fused cache install) "
+        "run through the Pallas interpreter on CPU; gated on interpret "
+        "mode working in this jax build")
 
 
 # ---------------------------------------------------------------------------
@@ -112,6 +118,30 @@ _UNSET = object()
 _MESH_BITEXACT_REASON = _UNSET
 _MP_COLLECTIVES_REASON = _UNSET
 _EMBEDDING_REASON = _UNSET
+_PALLAS_REASON = _UNSET
+
+
+def _probe_pallas_interpret():
+    """None if a minimal pallas_call runs under the interpreter on this
+    backend, else a skip reason. Unlike the other probes this one catches
+    its own exceptions: a crashing interpreter IS the missing capability."""
+    try:
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.experimental import pallas as pl
+
+        def k(x_ref, o_ref):
+            o_ref[...] = x_ref[...] + 1.0
+
+        out = pl.pallas_call(
+            k, out_shape=jax.ShapeDtypeStruct((4,), jnp.float32),
+            interpret=True)(jnp.zeros((4,), jnp.float32))
+        if not np.array_equal(np.asarray(out), np.ones((4,), np.float32)):
+            return "environment: pallas interpret mode returns wrong values"
+    except Exception as exc:  # noqa: BLE001
+        return ("environment: pallas interpret mode unavailable "
+                f"({type(exc).__name__}: {str(exc)[:120]})")
+    return None
 
 
 def _probe_mesh_bitexact():
@@ -255,6 +285,7 @@ def pytest_collection_modifyitems(config, items):
         # reason): both need real 2-process collectives on this backend.
         ("multichip", "_MP_COLLECTIVES_REASON", _probe_mp_collectives),
         ("embedding", "_EMBEDDING_REASON", _probe_embedding_sparse),
+        ("pallas", "_PALLAS_REASON", _probe_pallas_interpret),
     )
     for marker_name, cache_name, probe in probes:
         gated = [it for it in items if marker_name in it.keywords]

@@ -79,6 +79,53 @@ class TestColdStore:
         a = np.ones((1000, 8), np.float32)
         assert ColdStore(a, "int8").nbytes() <= a.nbytes / 2 + 4 * 1000
 
+    def test_fp8_roundtrip_within_quant_error(self):
+        rng = np.random.default_rng(2)
+        a = rng.standard_normal((40, 8)).astype(np.float32)
+        cs = ColdStore(a, "fp8_e4m3")
+        got = cs.fetch(np.arange(40))
+        # e4m3 keeps 3 mantissa bits: relative error <= 2^-4 per element
+        # (plus a whisker of slack for the scale rounding).
+        assert (np.abs(got - a) <= np.abs(a) * 0.0664 + 1e-6).all()
+        assert cs.nbytes() < a.nbytes / 2
+
+    def test_fp8_beats_int8_on_outlier_rows(self):
+        # One large outlier per row: int8's fixed step (row-max/127)
+        # flattens the small coordinates; fp8's relative precision keeps
+        # them. This asymmetry is WHY the fp8 tier exists.
+        rng = np.random.default_rng(3)
+        a = rng.standard_normal((64, 8)).astype(np.float32) * 1e-3
+        a[:, 0] = 100.0
+        e_int8 = np.abs(ColdStore(a, "int8").dense() - a)[:, 1:].max()
+        e_fp8 = np.abs(ColdStore(a, "fp8_e4m3").dense() - a)[:, 1:].max()
+        assert e_fp8 < e_int8 / 10
+
+    def test_fetch_write_reuse_scratch(self):
+        """fetch/write run on every cache transaction: after warmup they
+        must work out of per-store scratch (no fresh row-block allocation
+        per call — fetch returns a view into the reused buffer)."""
+        rng = np.random.default_rng(4)
+        a = rng.standard_normal((64, 8)).astype(np.float32)
+        for dt in ("float32", "int8", "fp8_e4m3"):
+            cs = ColdStore(a, dt)
+            out1 = cs.fetch(np.arange(4, 12))
+            base = out1.base
+            assert base is not None, dt  # a view, not a fresh array
+            assert cs.fetch(np.arange(8)).base is base, dt
+            assert cs.fetch(np.arange(3)).base is base, dt  # smaller reuses
+            cs.write(np.arange(5), a[:5])
+            if dt != "float32":
+                w = cs._write_f32
+                cs.write(np.arange(2, 7), a[2:7])
+                assert cs._write_f32 is w, dt
+        # Growth only on outsized requests, to the next power of two.
+        cs = ColdStore(a, "float32")
+        cs.fetch(np.arange(5))
+        cap = cs._fetch_f32.shape[0]
+        assert cap == 8
+        cs.fetch(np.arange(20))
+        assert cs._fetch_f32.shape[0] == 32
+
 
 @pytest.fixture(scope="module")
 def sparse_ref():
@@ -128,6 +175,47 @@ class TestTieredParity:
                        - np.asarray(dense.params[n], np.float32)).max()
             assert d < 5e-2, (n, d)
 
+    def test_fp8_cold_within_tolerance(self, sparse_ref):
+        _, s_ref = sparse_ref
+        tr, s_q = _run(_cfg(embedding_tiering="hot_cold",
+                            embedding_hot_rows=HOT, transfer_ahead=2,
+                            embedding_cold_dtype="fp8_e4m3"))
+        dense = tr._tier.densified(s_q)
+        for n in ("fm_w", "fm_v"):
+            d = np.abs(np.asarray(s_ref.params[n], np.float32)
+                       - np.asarray(dense.params[n], np.float32)).max()
+            assert d < 5e-2, (n, d)
+
+    def test_fused_install_matches_seed_install(self):
+        """The fused install (one launch per table transaction) must be
+        element-identical to the seed per-array ``_jit_install`` scatters
+        — the property that keeps the tiered bit-parity pins above green
+        with the kernels enabled."""
+        import jax.numpy as jnp
+
+        from deepfm_tpu.data import hot_cold as hc
+        from deepfm_tpu.ops import pallas_embedding as pemb
+
+        rng = np.random.default_rng(7)
+        H, D, n, p = 16, 4, 5, 8
+        w = jnp.asarray(rng.standard_normal((H, D)).astype(np.float32))
+        m, v = w * 0.5, w * 0.25
+        tau = jnp.asarray(rng.integers(0, 9, (H,)).astype(np.int32))
+        slots = np.full((p,), H, np.int32)
+        slots[:n] = rng.choice(H, n, replace=False)
+        wv = np.zeros((p, D), np.float32)
+        wv[:n] = rng.standard_normal((n, D))
+        mv, vv = wv * 2.0, wv * 3.0
+        tv = np.zeros((p,), np.int32)
+        tv[:n] = 7
+        got = pemb.install_rows(w, m, v, tau, jnp.asarray(slots),
+                                wv, mv, vv, tv, mode="xla")
+        assert got is not None
+        ref = (hc._jit_install(w, slots, wv), hc._jit_install(m, slots, mv),
+               hc._jit_install(v, slots, vv), hc._jit_install(tau, slots, tv))
+        for a, b in zip(got, ref):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
 
 class TestFaults:
     @pytest.mark.faults
@@ -171,6 +259,35 @@ class TestCapacity:
             _cfg(embedding_tiering="hot_cold", embedding_hot_rows=V)
 
 
+class TestInstallCompileCache:
+    def test_install_cache_bounded_by_pow2_ladder(self):
+        """Every transaction size from 1..MAX must funnel into at most
+        log2(pow2(MAX)) + 1 compiled fused-install programs (the pow2
+        padding ladder): unbounded per-size recompiles were the seed
+        ``_jit_install``'s failure mode at scale."""
+        import jax.numpy as jnp
+
+        from deepfm_tpu.data.hot_cold import _pow2_pad
+        from deepfm_tpu.ops import pallas_embedding as pemb
+
+        pemb.install_cache_clear()
+        H, D, max_n = 16, 4, 64
+        w = jnp.zeros((H, D), jnp.float32)
+        m, v = w, w
+        tau = jnp.zeros((H,), jnp.int32)
+        for n in range(1, max_n + 1):
+            p = _pow2_pad(n)
+            slots = jnp.full((p,), H, jnp.int32)  # all OOB: no-op install
+            out = pemb.install_rows(
+                w, m, v, tau, slots, jnp.zeros((p, D), jnp.float32),
+                jnp.zeros((p, D), jnp.float32),
+                jnp.zeros((p, D), jnp.float32),
+                jnp.zeros((p,), jnp.int32), mode="xla")
+            assert out is not None
+        import math
+        assert pemb.install_cache_size() <= math.log2(_pow2_pad(max_n)) + 1
+
+
 @pytest.mark.slow
 class TestBenchDrill:
     def test_bench_embedding_quick(self, tmp_path):
@@ -191,3 +308,14 @@ class TestBenchDrill:
         assert report["load_kind"] == "synthetic-ctr"
         assert report["scaling"]["cost_tracks_uniques_not_vocab"] is True
         assert report["hot_cold"]["overlap_ok"] is True
+        # Kernel plane: the kill-switch parity pin must hold in the drill
+        # (the sparse_beats_dense headline is asserted only on the full
+        # run's committed artifact — quick windows are noise-band).
+        kern = report["kernels"]
+        assert kern["killswitch_parity"]["losses_bitequal"] is True
+        assert kern["killswitch_parity"]["max_param_divergence"] < 1e-6
+        assert {e["kernel"] for e in kern["ab"]} >= {
+            "plan", "take", "install", "select_writeback"}
+        assert all(e["chosen"] in ("ref", "opt", "pallas")
+                   for e in kern["ab"])
+        assert "sparse_beats_dense" in report["sparse_vs_dense"]
