@@ -302,6 +302,17 @@ class Config:
     # "off" is the kill switch — the seed formulation everywhere,
     # bit-for-bit. TUNING §2.11 has the selection table.
     embedding_kernels: str = "auto"   # auto | pallas | xla | off
+    # Model-parallel row sharding of the embedding tables under the SPARSE
+    # update path: "rows" partitions every logical table (monolithic or
+    # hash-bucketed) contiguously over the model mesh axis with the
+    # lazy-Adam moments sharded alongside, so per-device embedding HBM
+    # drops ~1/mesh_model. Per step the batch's dedup plan is bucketed by
+    # owner shard, request sets cross lax.all_to_all, owners gather and
+    # update only their own rows, and a second all_to_all returns the
+    # embeddings (ops/embedding.py exchange_*). On one device (or
+    # mesh_model=1) this routes to the literal unsharded sparse program —
+    # bit-identical by construction. TUNING §2.11 has the decision guide.
+    embedding_shard: str = "off"      # off | rows
 
     # ---- checkpoint / export / logging ----
     model_dir: str = ""               # checkpoint dir (shared storage; reference :434)
@@ -529,12 +540,11 @@ class Config:
                     "embedding_update=sparse implements the lazy/timestamped "
                     "row update for Adam only; use --optimizer Adam or "
                     "--embedding_update dense")
-            if self.mesh_model > 1:
+            if self.mesh_model > 1 and self.embedding_shard != "rows":
                 raise ValueError(
-                    "embedding_update=sparse does not compose with row-"
-                    "sharded tables (mesh_model>1): per-shard touch sets "
-                    "would diverge the replicas; use --embedding_update "
-                    "dense")
+                    "embedding_update=sparse under mesh_model>1 needs the "
+                    "row-exchange plane: set --embedding_shard rows (or "
+                    "--embedding_update dense)")
         try:
             buckets = self.embedding_bucket_sizes
         except ValueError as exc:
@@ -546,9 +556,17 @@ class Config:
                 f"embedding_buckets must be positive ints, got "
                 f"{self.embedding_buckets!r}")
         if buckets and self.mesh_model > 1:
-            raise ValueError(
-                "hash-bucketed multi-table embeddings (embedding_buckets) "
-                "do not row-shard yet; mesh_model must be 1")
+            if self.embedding_shard != "rows":
+                raise ValueError(
+                    "hash-bucketed multi-table embeddings (embedding_"
+                    "buckets) row-shard only via --embedding_shard rows; "
+                    "otherwise mesh_model must be 1")
+            bad = [b for b in buckets if b % self.mesh_model]
+            if bad:
+                raise ValueError(
+                    f"embedding_shard=rows needs every bucket count "
+                    f"divisible by mesh_model={self.mesh_model}; "
+                    f"got {bad}")
         if self.embedding_assign not in ("hash", "field"):
             raise ValueError(
                 f"embedding_assign must be hash|field, got "
@@ -565,6 +583,30 @@ class Config:
             raise ValueError(
                 f"embedding_kernels must be auto|pallas|xla|off, got "
                 f"{self.embedding_kernels!r}")
+        if self.embedding_shard not in ("off", "rows"):
+            raise ValueError(
+                f"embedding_shard must be off|rows, got "
+                f"{self.embedding_shard!r}")
+        if self.embedding_shard == "rows":
+            if self.embedding_update != "sparse":
+                raise ValueError(
+                    "embedding_shard=rows rides the sparse row plane; set "
+                    "--embedding_update sparse")
+            if self.embedding_tiering != "off":
+                raise ValueError(
+                    "embedding_shard=rows and embedding_tiering are "
+                    "mutually exclusive (pick HBM capacity from more chips "
+                    "OR from the host cold store — TUNING §2.11)")
+            if self.grad_accum_steps > 1:
+                raise ValueError(
+                    "embedding_shard=rows does not compose with "
+                    "grad_accum_steps > 1 yet (the merged-plan accumulation "
+                    "path is single-device)")
+            if self.device_dataset:
+                raise ValueError(
+                    "embedding_shard=rows is not supported with "
+                    "device_dataset (the on-device gather feed is "
+                    "single-device)")
         if self.embedding_tiering == "hot_cold":
             if self.embedding_update != "sparse":
                 raise ValueError(

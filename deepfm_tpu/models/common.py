@@ -237,6 +237,23 @@ class EmbeddingSchema:
         self.kernels = getattr(cfg, "embedding_kernels", "auto")
         self.padded_vocab = emb_ops.padded_vocab(
             cfg.feature_size, cfg.mesh_model)
+        # Row-sharding metadata (--embedding_shard rows): num_shards is the
+        # model-axis size the tables are partitioned over; 1 means every
+        # device holds full tables (the replicated layout). Table SHAPES
+        # never depend on this (padded_vocab is mesh-independent), only
+        # the placement and the step program do.
+        self.shard_rows = getattr(cfg, "embedding_shard", "off") == "rows"
+        self.num_shards = max(int(cfg.mesh_model), 1) if self.shard_rows else 1
+
+    def table_rows(self, key: str) -> int:
+        """Global row count of one physical table."""
+        if not self.hashed:
+            return self.padded_vocab
+        return self.buckets[int(key[1:])]
+
+    def rows_local(self, key: str) -> int:
+        """Rows per shard of one table (== table_rows when unsharded)."""
+        return self.table_rows(key) // self.num_shards
 
     # -- layout ---------------------------------------------------------
     def table_keys(self) -> List[str]:
@@ -279,14 +296,30 @@ class EmbeddingSchema:
             return emb_ops.lookup(entry, feat_ids, axis_name=axis_name,
                                   strategy=self.lookup_strategy)
         table_of = self._table_of(feat_ids)
+        shard = (jax.lax.axis_index(axis_name)
+                 if axis_name is not None else None)
         out = None
         for i, b in enumerate(self.buckets):
             bucket = emb_ops.hash_bucket(feat_ids, b, salt=i + 1)
-            part = jnp.take(entry[f"t{i}"], bucket, axis=0)
+            tab = entry[f"t{i}"]
+            if shard is None:
+                part = jnp.take(tab, bucket, axis=0)
+            else:
+                # Row-sharded bucket (--embedding_shard rows): local
+                # masked take; ONE psum below reassembles every bucket's
+                # shard contributions at once.
+                local = bucket - shard * tab.shape[0]
+                ok = (local >= 0) & (local < tab.shape[0])
+                part = jnp.take(tab, jnp.clip(local, 0, tab.shape[0] - 1),
+                                axis=0)
+                okx = ok.reshape(ok.shape + (1,) * (part.ndim - ok.ndim))
+                part = jnp.where(okx, part, jnp.zeros((), part.dtype))
             sel = (table_of == i).astype(part.dtype)
             sel = sel.reshape(sel.shape + (1,) * (part.ndim - sel.ndim))
             part = part * sel
             out = part if out is None else out + part
+        if axis_name is not None:
+            out = jax.lax.psum(out, axis_name)
         return out
 
     # -- sparse-update plan ---------------------------------------------

@@ -320,6 +320,146 @@ def set_rows_scalar(table: jax.Array, entry: PlanEntry,
     return jnp.where(keep, jnp.asarray(value, table.dtype), table)
 
 
+# ---------------------------------------------------------------------------
+# Row-sharded all-to-all exchange (--embedding_shard rows)
+# ---------------------------------------------------------------------------
+# The sparse path's plan (PlanEntry.uids, sorted ascending with OOB fill)
+# meets a row-sharded table here: each model peer takes an equal contiguous
+# slice of the uid positions, buckets its slice by owner shard (sorted uids
+# make owner runs contiguous — two searchsorted calls give the bucket
+# bounds), ships static-shape padded request sets over ``lax.all_to_all``,
+# the owners answer with a second all_to_all, and a zeros+psum reassembly
+# replicates the gathered rows on every peer (psum output is provably
+# replicated, which shard_map's check_vma needs downstream; all_gather's is
+# not). Every element of the result has exactly ONE nonzero contributor in
+# the psum, so the exchange is bit-identical to ``gather_rows`` on the
+# unsharded table — no float reassociation anywhere.
+
+
+class ExchangePlan(NamedTuple):
+    """Static-shape routing for one table's row exchange, built per step
+    from the (model-replicated) PlanEntry. All shapes are static: ``reqs``
+    pads each owner bucket to the slice capacity C = ceil(U / D) with the
+    OOB id ``num_rows``, which owners answer with zero rows and the
+    reassembly never reads.
+
+    reqs:     int32 [D, C]  row ids this peer requests from each owner.
+    flat_idx: int32 [C]     position into the flattened [D*C] response
+                            block for this peer's slice; D*C (OOB -> fill 0)
+                            for pad slots.
+    num_rows: int           global rows in the table (OOB fill id).
+    rows_local: int         rows per shard (num_rows // num_shards).
+    num_shards: int         model-axis size D.
+    n_ids: int              U — uid slot count (static = batch ids.size).
+    """
+    reqs: jax.Array
+    flat_idx: jax.Array
+    num_rows: int
+    rows_local: int
+    num_shards: int
+    n_ids: int
+
+
+def build_exchange(entry: PlanEntry, num_shards: int,
+                   axis_name: str) -> ExchangePlan:
+    """Bucket this peer's uid slice by owner shard. Must run inside
+    shard_map over ``axis_name``; the batch (hence the plan) is replicated
+    over the model axis, so slicing by ``axis_index`` splits the request
+    work D ways without any prior communication."""
+    if entry.num_rows % num_shards:
+        raise ValueError(
+            f"table rows {entry.num_rows} not divisible by {num_shards} "
+            f"shards")
+    uids = entry.uids
+    n = uids.shape[0]
+    d = num_shards
+    rows_local = entry.num_rows // d
+    cap = -(-n // d)
+    r = jax.lax.axis_index(axis_name)
+    pad = jnp.full((d * cap - n,), entry.num_rows, uids.dtype)
+    u_pad = jnp.concatenate([uids, pad])          # sorted: fill is the max
+    sl = jax.lax.dynamic_slice_in_dim(u_pad, r * cap, cap)
+    bounds = jnp.searchsorted(
+        sl, jnp.arange(d + 1, dtype=sl.dtype) * rows_local,
+        side="left").astype(jnp.int32)            # [D+1] owner-run bounds
+    starts, ends = bounds[:-1], bounds[1:]
+    idx = starts[:, None] + jnp.arange(cap, dtype=jnp.int32)[None, :]
+    valid = idx < ends[:, None]
+    reqs = jnp.where(valid, jnp.take(sl, jnp.clip(idx, 0, cap - 1)),
+                     entry.num_rows).astype(jnp.int32)
+    owner = (sl // rows_local).astype(jnp.int32)  # fill ids land on D
+    rank = jnp.arange(cap, dtype=jnp.int32) - jnp.take(
+        starts, jnp.clip(owner, 0, d - 1))
+    flat_idx = jnp.where(owner < d, owner * cap + rank, d * cap)
+    return ExchangePlan(reqs=reqs, flat_idx=flat_idx,
+                        num_rows=entry.num_rows, rows_local=rows_local,
+                        num_shards=d, n_ids=n)
+
+
+def exchange_rows(local_table: jax.Array, ex: ExchangePlan,
+                  axis_name: str) -> jax.Array:
+    """Gather ``ex``'s uid rows from a row-sharded table: all_to_all the
+    request sets, owner-gather (OOB and other-shard ids read zero),
+    all_to_all the responses back, reassemble + replicate via psum.
+    Returns [U, ...rows] bit-identical to ``gather_rows`` on the full
+    table. Runs inside shard_map over ``axis_name``."""
+    d, cap = ex.num_shards, ex.reqs.shape[1]
+    r = jax.lax.axis_index(axis_name)
+    recv = jax.lax.all_to_all(ex.reqs, axis_name, split_axis=0,
+                              concat_axis=0, tiled=True)   # [D, C] asks
+    local = recv - r * ex.rows_local
+    ok = (local >= 0) & (local < ex.rows_local)
+    safe = jnp.where(ok, local, ex.rows_local)
+    resp = jnp.take(local_table, safe.reshape(-1), axis=0, mode="fill",
+                    fill_value=0).reshape((d, cap) + local_table.shape[1:])
+    got = jax.lax.all_to_all(resp, axis_name, split_axis=0,
+                             concat_axis=0, tiled=True)    # [D, C, ...]
+    flat = got.reshape((d * cap,) + got.shape[2:])
+    mine = jnp.take(flat, ex.flat_idx, axis=0, mode="fill", fill_value=0)
+    full = jnp.zeros((d * cap,) + mine.shape[1:], mine.dtype)
+    full = jax.lax.dynamic_update_slice_in_dim(full, mine, r * cap, axis=0)
+    full = jax.lax.psum(full, axis_name)
+    return full[:ex.n_ids]
+
+
+def owner_scatter_add(g_rows: jax.Array, entry: PlanEntry, num_shards: int,
+                      axis_name: Optional[str]) -> tuple[jax.Array, jax.Array]:
+    """Scatter per-uid cotangents into this shard's table space.
+
+    Returns (grad [rows_local, ...], touched bool [rows_local]): the
+    contribution of THIS replica's batch to the rows this shard owns.
+    Ids owned elsewhere (and the plan's OOB fill slots) route to the
+    ``rows_local`` sentinel and are dropped by XLA's default scatter mode —
+    the sentinel is non-negative on purpose, negative indices would wrap.
+    With ``axis_name=None`` (one shard) this degrades to the plain
+    table-space segment scatter."""
+    rows_local = entry.num_rows // num_shards
+    off = 0
+    if axis_name is not None:
+        off = jax.lax.axis_index(axis_name) * rows_local
+    local = entry.uids - off
+    owned = (local >= 0) & (local < rows_local) & valid_rows(entry)
+    safe = jnp.where(owned, local, rows_local)
+    grad = jnp.zeros((rows_local,) + g_rows.shape[1:],
+                     g_rows.dtype).at[safe].add(g_rows)
+    touched = jnp.zeros((rows_local,), jnp.bool_).at[safe].set(True)
+    return grad, touched
+
+
+def exchange_payload_bytes(n_ids: int, row_elems: int, num_shards: int,
+                           itemsize: int = 4) -> int:
+    """Analytic per-device bytes for one table's forward exchange: the
+    request all_to_all (D·C int32 ids), the response all_to_all (D·C rows),
+    and the psum reassembly buffer (D·C rows; a ring all-reduce moves
+    ~2(D-1)/D of it per device). C = ceil(n_ids / D). Zero when unsharded.
+    TUNING §2.11 derives when this beats replicating the table."""
+    if num_shards <= 1:
+        return 0
+    cap = -(-n_ids // num_shards)
+    block = num_shards * cap
+    return block * 4 + 2 * block * row_elems * itemsize
+
+
 def pad_row_mask(num_rows_local: int, feature_size: int,
                  axis_name: Optional[str] = None) -> jax.Array:
     """Bool [num_rows_local]: True for real vocabulary rows, False for

@@ -394,3 +394,30 @@ def reference_plan_numpy(ids, num_rows):
     rank[uniq[uniq < num_rows]] = np.arange(uniq.size)[uniq < num_rows]
     return (uids, inv.reshape(np.asarray(ids).shape).astype(np.int32),
             touched, rank)
+
+
+def reference_exchange_numpy(uids, num_rows, num_shards, shard):
+    """Sequential-scan oracle for ``ops.embedding.build_exchange`` (tests).
+
+    Walks this shard's uid slice in order and assigns each valid id the
+    next slot of its owner's request bucket — for a SORTED uid list that
+    is exactly the searchsorted bucketing the jit builder computes.
+    Returns (reqs [D, C] int32, flat_idx [C] int32)."""
+    import numpy as np
+    uids = np.asarray(uids, np.int64)
+    cap = -(-uids.size // num_shards)
+    rows_local = num_rows // num_shards
+    pad = np.full((num_shards * cap,), num_rows, np.int64)
+    pad[:uids.size] = uids
+    sl = pad[shard * cap:(shard + 1) * cap]
+    reqs = np.full((num_shards, cap), num_rows, np.int32)
+    flat_idx = np.full((cap,), num_shards * cap, np.int32)
+    counts = [0] * num_shards
+    for j, uid in enumerate(sl):
+        if uid >= num_rows:
+            continue
+        owner = int(uid // rows_local)
+        reqs[owner, counts[owner]] = uid
+        flat_idx[j] = owner * cap + counts[owner]
+        counts[owner] += 1
+    return reqs, flat_idx

@@ -173,18 +173,37 @@ def hierarchical_pmean(tree: Any, axis_name: str, groups: HierGroups,
 
 
 def grad_payload_bytes(params: Any, embedding_names: Tuple[str, ...],
-                       model_size: int = 1) -> int:
+                       model_size: int = 1, *,
+                       embedding_shard: str = "off") -> int:
     """Per-device bytes moved by one gradient all-reduce over 'data'.
 
-    Embedding tables are row-sharded over 'model' so each device reduces
-    only its 1/model_size slice; everything else is replicated and reduced
-    in full. Analytic (ring algorithms move ~2x this; we report payload).
+    Dense path: embedding tables row-sharded over 'model' reduce only
+    their 1/model_size slice; everything else is replicated and reduced in
+    full. Analytic (ring algorithms move ~2x this; we report payload).
+
+    Under ``embedding_shard="rows"`` the sparse step never reduces a dense
+    row-space gradient: each owner psums its LOCAL table-space
+    contribution — every global row counted exactly once, on its owner,
+    whatever the mesh shape (with mesh_model=1 that is the full table, NOT
+    divided) — plus ONE touched-union mask per physical table (int32
+    [rows_local], shared by all embedding names, counted against the
+    first). The forward row exchange is separate traffic over 'model'
+    (ops.embedding.exchange_payload_bytes), not part of this reduce.
     """
+    first = embedding_names[0] if embedding_names else None
 
     def leaf_bytes(path: Tuple, leaf: Any) -> int:
         names = {getattr(p, "key", getattr(p, "name", None)) for p in path}
         nbytes = int(np.prod(leaf.shape)) * leaf.dtype.itemsize
-        if model_size > 1 and names & set(embedding_names):
+        if not names & set(embedding_names):
+            return nbytes
+        if embedding_shard == "rows":
+            shards = max(model_size, 1)
+            owned = nbytes // shards
+            if first in names:
+                owned += (int(leaf.shape[0]) // shards) * 4
+            return owned
+        if model_size > 1:
             return nbytes // model_size
         return nbytes
 

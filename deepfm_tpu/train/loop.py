@@ -47,6 +47,7 @@ except ImportError:  # jax < 0.5: experimental location, check_rep kwarg
 from ..config import Config
 from ..models import get_model
 from ..obs import trace as trace_lib
+from ..ops import embedding as emb_ops
 from ..ops import pallas_embedding as pemb
 from ..parallel import mesh as mesh_lib
 from ..utils import logging as ulog
@@ -221,18 +222,28 @@ class Trainer:
         self._donate_state = cfg.on_nonfinite != "skip"
         # Injectable watchdog abort (tests); None = os._exit(EXIT_WATCHDOG).
         self.watchdog_abort: Optional[Callable[[str], None]] = None
-        # Sparse (touched-rows-only) embedding updates: single-device jit
-        # path only — under a mesh the per-shard plans would desync the
-        # replicated tables, so fall back to dense rather than diverge.
+        # Sparse (touched-rows-only) embedding updates. Two legs: the
+        # single-device jit path, and — with --embedding_shard rows — the
+        # row-exchange mesh program (_sharded_sparse_step_impl), where
+        # tables and Adam moments live sharded over 'model' and grads sync
+        # over 'data' in owner-local table space. A mesh WITHOUT the rows
+        # plane still falls back to dense: replicated tables with
+        # per-shard sparse plans would desync.
         self.sparse_embed = cfg.embedding_update == "sparse"
-        if self.sparse_embed and self.mesh_info.mesh is not None:
+        self._shard_rows = cfg.embedding_shard == "rows"
+        if (self.sparse_embed and self.mesh_info.mesh is not None
+                and not self._shard_rows):
             ulog.warning(
-                "embedding_update=sparse supports the single-device jit "
-                "path only; a mesh is present -> falling back to dense "
-                "embedding updates")
+                "embedding_update=sparse under a mesh needs the row "
+                "exchange plane (--embedding_shard rows) -> falling back "
+                "to dense embedding updates")
             self.sparse_embed = False
         self._embed_names = tuple(self.model.embedding_param_names())
-        self._sparse_lr = cfg.learning_rate  # world == 1 on the sparse path
+        # Embedding rows follow the same world-LR rule as the optax base
+        # optimizer (opt_lib.build_optimizer).
+        self._sparse_lr = cfg.learning_rate
+        if cfg.scale_lr_by_world and self.mesh_info.data_size > 1:
+            self._sparse_lr = cfg.learning_rate * self.mesh_info.data_size
         # Kernel-leg selection for the sparse embedding plane (see
         # ops.pallas_embedding): "off" is the kill switch that also
         # disables the fused one-leaf backward below.
@@ -304,8 +315,31 @@ class Trainer:
         param_specs = mesh_lib.param_pspecs(
             state.params, self.model.embedding_param_names(),
             self.mesh_info.model_size)
-        opt_specs = mesh_lib.opt_state_pspecs(
-            state.opt_state, state.params, param_specs)
+        if self.sparse_embed:
+            # Sparse opt layout {"base", "embed", "count"}: the lazy-Adam
+            # m/v mirror their table's spec; tau is a [rows] int vector
+            # that shards with the rows — opt_state_pspecs's shape
+            # matching would only catch it by accidental collision with a
+            # 1-D param, so the layout is spelled out here.
+            emb = self.model.emb
+            rest = {k: v for k, v in state.params.items()
+                    if k not in self._embed_names}
+            rest_specs = {k: param_specs[k] for k in rest}
+            row_spec = (P(mesh_lib.MODEL_AXIS)
+                        if self.mesh_info.model_size > 1 else P())
+            embed_specs = {
+                name: {key: opt_lib.EmbedAdamEntry(m=s, v=s, tau=row_spec)
+                       for key, s in emb.tables(param_specs[name]).items()}
+                for name in self._embed_names}
+            opt_specs = {
+                "base": mesh_lib.opt_state_pspecs(
+                    state.opt_state["base"], rest, rest_specs),
+                "embed": embed_specs,
+                "count": P(),
+            }
+        else:
+            opt_specs = mesh_lib.opt_state_pspecs(
+                state.opt_state, state.params, param_specs)
         mstate_specs = jax.tree.map(lambda _: P(), state.model_state)
         return TrainState(
             step=P(), params=param_specs, opt_state=opt_specs,
@@ -380,8 +414,11 @@ class Trainer:
                    ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
         """One optimizer step (raw, mesh-axis-aware; wrapped by jit/shard_map
         in _make_train_step and scanned in _make_train_multi_step)."""
-        if self.sparse_embed and data_axis is None and shard_axis is None:
-            return self._sparse_step_impl(state, batch)
+        if self.sparse_embed:
+            if data_axis is None and shard_axis is None:
+                return self._sparse_step_impl(state, batch)
+            return self._sharded_sparse_step_impl(
+                state, batch, data_axis=data_axis, shard_axis=shard_axis)
         rng = jax.random.fold_in(state.rng, state.step)
         if data_axis is not None:
             # Distinct dropout per data shard; identical across model
@@ -629,6 +666,152 @@ class Trainer:
             emb_params, new_embed = self._sparse_apply(
                 state, plan, rows0, g_rows, count)
         new_params.update(emb_params)
+        new_opt = {"base": new_base, "embed": new_embed, "count": count}
+        new_state = state.replace(
+            step=state.step + 1, params=new_params, opt_state=new_opt,
+            model_state=new_mstate)
+        return new_state, {"loss": xent + l2, "xent": xent}
+
+    def _sharded_sparse_step_impl(self, state: TrainState, batch, *,
+                                  data_axis, shard_axis
+                                  ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
+        """One sparse optimizer step under the ('data','model') mesh with
+        row-sharded tables (``--embedding_shard rows``).
+
+        Topology per step (runs inside shard_map; tables + Adam moments
+        live as [rows/D, ...] shards over 'model', the batch is sharded
+        over 'data' and replicated over 'model'):
+
+          1. The local batch's dedup plan is built exactly as on the
+             single-device path — model peers see the same batch, so the
+             plan (and its sorted uid list) is model-replicated for free.
+          2. ``build_exchange`` splits request responsibility by uid
+             position across the D model peers (C = ceil(U/D) ids each),
+             ``exchange_rows`` moves requests/responses via two tiled
+             ``all_to_all``s and reassembles the [U, ...] row block with a
+             psum — bit-identical to gathering from the full table.
+          3. The TOUCHED ROWS are the gradient leaf (same AD shape as the
+             single-device plan leg); the in-loss pmean over 'data' is THE
+             gradient sync for the dense params, and scales the row
+             cotangents by 1/dp.
+          4. ``owner_scatter_add`` lands each replica's cotangents in
+             owner-local table space; a psum over 'data' then sums the
+             1/dp-scaled contributions — i.e. the cross-replica pmean —
+             and unions the touched masks. Each owner lazy-Adam-sweeps
+             only its own rows (sparse_adam_masked), so optimizer work
+             and moment HBM both scale 1/D.
+
+        Touched-rows L2 is applied post-hoc against the UNION touched mask
+        (fused-apply style): putting it in the per-replica loss would
+        weight a row by how many replicas touched it (k/dp), diverging
+        from the single-device semantics this path is pinned against.
+
+        Unlike the dense step, the loss here carries NO collectives at
+        all: the gradients come out per-replica LOCAL and the pmeans are
+        explicit, after AD (the hierarchical dense leg's idiom). That
+        sidesteps the in-loss-pmean transpose entirely — whose scaling
+        shifted between the legacy shard_map AD and the vma-typed one —
+        so this program means the same thing on either. The hierarchical
+        two-stage 'data' reduce is NOT composed with this path (grads
+        never materialize as one dense tree to stage)."""
+        emb = self.model.emb
+        d = self.mesh_info.model_size if shard_axis is not None else 1
+        rng = jax.random.fold_in(state.rng, state.step)
+        if data_axis is not None:
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(data_axis))
+        tabs = {n: state.params[n] for n in self._embed_names}  # local shards
+        rest0 = {k: v for k, v in state.params.items()
+                 if k not in self._embed_names}
+        plan = emb.sparse_plan(batch["feat_ids"])
+        if d > 1:
+            ex = {key: emb_ops.build_exchange(e, d, shard_axis)
+                  for key, e in plan.items()}
+            rows0 = {n: {key: emb_ops.exchange_rows(
+                             emb.tables(tabs[n])[key], ex[key], shard_axis)
+                         for key in plan}
+                     for n in self._embed_names}
+        else:
+            rows0 = {n: emb.gather_rows(tabs[n], plan)
+                     for n in self._embed_names}
+
+        def loss_fn(diff):
+            rows, rest = diff
+            params = {**rest, **tabs}
+            logits, new_mstate = self.model.apply(
+                params, state.model_state, batch["feat_ids"],
+                batch["feat_vals"], train=True, rng=rng,
+                shard_axis=None, data_axis=data_axis,
+                emb_rows=rows, emb_plan=plan, **self._hist_kwargs(batch))
+            labels = self._batch_labels(batch)
+            xent = jnp.mean(self._per_example_loss(logits, labels))
+            return xent, (xent, new_mstate)
+
+        (_, (xent, new_mstate)), (g_rows, g_rest) = (
+            jax.value_and_grad(loss_fn, has_aux=True)((rows0, rest0)))
+        if data_axis is not None:
+            # THE gradient sync point, explicit and post-AD: per-replica
+            # local-mean grads -> the global-batch mean (row leaves sync
+            # below, in owner table space).
+            g_rest = jax.tree.map(
+                lambda g: jax.lax.pmean(g, data_axis), g_rest)
+            xent = jax.lax.pmean(xent, data_axis)
+
+        opt = state.opt_state
+        upd_rest, new_base = self.tx.update(g_rest, opt["base"], rest0)
+        new_rest = optax.apply_updates(rest0, upd_rest)
+        count = opt["count"] + 1
+        opt_embed = opt["embed"]
+        l2_reg = self.cfg.l2_reg
+        new_tabs: Dict[str, Dict[str, jax.Array]] = {
+            n: {} for n in self._embed_names}
+        new_embed: Dict[str, Dict[str, Any]] = {
+            n: {} for n in self._embed_names}
+        l2 = jnp.zeros((), jnp.float32)
+        for key, e in plan.items():
+            scat = {n: emb_ops.owner_scatter_add(
+                        g_rows[n][key], e, d,
+                        shard_axis if d > 1 else None)
+                    for n in self._embed_names}
+            grads = {n: scat[n][0] for n in self._embed_names}
+            touched = scat[self._embed_names[0]][1]
+            if data_axis is not None:
+                # pmean of the owner-local scatters == the global-batch
+                # mean grad per owned row; touched becomes the UNION.
+                grads = jax.tree.map(
+                    lambda g: jax.lax.pmean(g, data_axis), grads)
+                touched = jax.lax.psum(
+                    touched.astype(jnp.int32), data_axis) > 0
+            # Shared lazy-decay pair per physical table (tau is identical
+            # across names — same touched set every step); exp2 form and
+            # barrier exactly as in _fused_apply.
+            tau = opt_embed[self._embed_names[0]][key].tau
+            idle = (count - tau).astype(jnp.float32)
+            decay = jax.lax.optimization_barrier(
+                (jnp.exp2(idle * np.float32(np.log2(0.9))),
+                 jnp.exp2(idle * np.float32(np.log2(0.999)))))
+            for name in self._embed_names:
+                tab = emb.tables(tabs[name])[key]
+                g_eff = grads[name]
+                if l2_reg:
+                    g_eff = g_eff + l2_reg * tab.astype(jnp.float32)
+                new_tab, new_oe = opt_lib.sparse_adam_masked(
+                    tab, g_eff, touched, opt_embed[name][key], count,
+                    lr=self._sparse_lr, decay=decay)
+                new_tabs[name][key] = new_tab
+                new_embed[name][key] = new_oe
+                if l2_reg:
+                    sq = jnp.square(tab.astype(jnp.float32))
+                    keep = touched.reshape(
+                        touched.shape + (1,) * (sq.ndim - 1))
+                    l2 = l2 + 0.5 * jnp.sum(
+                        jnp.where(keep, sq, jnp.zeros((), sq.dtype)))
+        l2 = l2_reg * l2
+        if l2_reg and shard_axis is not None:
+            # Per-shard partials -> the full-table touched-L2 scalar.
+            l2 = jax.lax.psum(l2, shard_axis)
+        new_params = dict(new_rest)
+        for name in self._embed_names:
+            new_params[name] = emb.from_tables(new_tabs[name])
         new_opt = {"base": new_base, "embed": new_embed, "count": count}
         new_state = state.replace(
             step=state.step + 1, params=new_params, opt_state=new_opt,
@@ -1121,6 +1304,19 @@ class Trainer:
             if self._multitask:
                 batch["label2"] = jax.ShapeDtypeStruct(
                     (self.cfg.batch_size, 1), jnp.float32)
+            if (getattr(self.model, "uses_history", False)
+                    and self.cfg.history_max_len > 0):
+                # History runs (history_max_len > 0) carry the fixed-shape
+                # pair in every batch (zero_batch emits all-masked fillers
+                # for lockstep) — the shard_map in_specs tree must include
+                # them or any DIN/BST mesh run dies on pytree structure
+                # mismatch. At history_max_len == 0 the zoo feeds plain
+                # batches and the models default to an empty history.
+                hl = self.cfg.history_max_len
+                batch["hist_ids"] = jax.ShapeDtypeStruct(
+                    (self.cfg.batch_size, hl), jnp.int32)
+                batch["hist_mask"] = jax.ShapeDtypeStruct(
+                    (self.cfg.batch_size, hl), jnp.float32)
             eval_batch = dict(batch)
             eval_batch["weight"] = jax.ShapeDtypeStruct(
                 (self.cfg.batch_size, 1), jnp.float32)
@@ -1188,7 +1384,9 @@ class Trainer:
                 lambda: self._abstract_state_for_specs())
             self._grad_bytes_cache = mesh_lib.grad_payload_bytes(
                 abstract.params, self._embed_names,
-                self.mesh_info.model_size)
+                self.mesh_info.model_size,
+                embedding_shard=("rows" if self.sparse_embed
+                                 and self._shard_rows else "off"))
         return self._grad_bytes_cache
 
     def _stage(self, batches: Iterable[Dict[str, np.ndarray]], k: int,

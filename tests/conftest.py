@@ -87,6 +87,12 @@ def pytest_configure(config):
         "build, fused gather/segment-sum backward, fused cache install) "
         "run through the Pallas interpreter on CPU; gated on interpret "
         "mode working in this jax build")
+    config.addinivalue_line(
+        "markers",
+        "shard: row-sharded embedding tests (--embedding_shard rows: "
+        "all-to-all row exchange, sharded lazy-Adam, resharding "
+        "checkpoints) that compare mesh vs single-device trajectories; "
+        "gated on the mesh_bitexact probe")
 
 
 # ---------------------------------------------------------------------------
@@ -280,6 +286,9 @@ def _cached_reason(cache_name, probe):
 def pytest_collection_modifyitems(config, items):
     probes = (
         ("mesh_bitexact", "_MESH_BITEXACT_REASON", _probe_mesh_bitexact),
+        # row-sharding parity shares the mesh-bitexact probe (and its
+        # cached reason): both compare mesh trajectories to single-device.
+        ("shard", "_MESH_BITEXACT_REASON", _probe_mesh_bitexact),
         ("mp_collectives", "_MP_COLLECTIVES_REASON", _probe_mp_collectives),
         # multichip shares the mp_collectives probe (and its cached
         # reason): both need real 2-process collectives on this backend.

@@ -301,13 +301,23 @@ class TestBenchDrill:
         proc = subprocess.run(
             [sys.executable, os.path.join(root, "scripts",
                                           "bench_embedding.py"),
-             "--quick", "--out", out],
-            env=env, capture_output=True, text=True, timeout=540)
+             "--quick", "--sharded", "--out", out],
+            env=env, capture_output=True, text=True, timeout=570)
         assert proc.returncode == 0, proc.stderr[-2000:]
         report = json.load(open(out))
         assert report["load_kind"] == "synthetic-ctr"
         assert report["scaling"]["cost_tracks_uniques_not_vocab"] is True
         assert report["hot_cold"]["overlap_ok"] is True
+        # Row-sharding A/B: per-device embedding HBM must scale ~1/D and
+        # the honesty refusal must be in-band (no fake speedup claims on
+        # the time-sliced virtual mesh).
+        rs = report["row_sharding"]
+        assert rs["hbm_scales_with_shards"] is True
+        assert rs["scaling_efficiency"] is None
+        assert "refused" in rs["scaling_efficiency_refused"]
+        assert rs["series"][0]["exchange_payload_bytes_per_step"] == 0
+        assert all(row["exchange_payload_bytes_per_step"] > 0
+                   for row in rs["series"][1:])
         # Kernel plane: the kill-switch parity pin must hold in the drill
         # (the sparse_beats_dense headline is asserted only on the full
         # run's committed artifact — quick windows are noise-band).
