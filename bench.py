@@ -639,6 +639,203 @@ def serving_series(replicas: int = 1, inflight: int = 2,
     }
 
 
+def experiment_series(n_requests: int = 150, max_req: int = 4,
+                      permille: int = 100, qps: float = 50.0,
+                      rounds: int = 5) -> dict:
+    """Cost of the gated-deployment plane, in three numbers.
+
+    1. **Shadow overhead** — primary-lane p99 for the SAME deterministic
+       paced request stream served bare (one engine) vs. through an
+       ``ExperimentRouter`` in shadow mode (``permille``/1000 of requests
+       duplicated to a second engine on the side lane). The acceptance bar
+       is < 10% p99 overhead on this host. Two design choices make the
+       number mean something on a 1-core box: the load is a PACED open
+       loop below saturation (production serving is not run at 100% CPU —
+       a back-to-back closed loop would measure core time-slicing, not
+       router overhead), and the challenger engine batches its shadow rows
+       with a generous ``max_delay_ms`` — the shadow lane's response is
+       never returned to anyone, so it is latency-insensitive by
+       definition, and delaying its flush schedules challenger compute
+       into the pacing gaps instead of on top of the primary's own
+       service window. Baseline and shadow passes ALTERNATE for
+       ``rounds`` rounds and the reported p99s are medians-of-rounds, so
+       host drift (the dominant noise source here) hits both arms
+       equally.
+    2. **Promotion pointer-move latency** — wall time of the
+       ``PromotionController.observe()`` call that PROMOTES (history
+       append + atomic ``LATEST`` move), sampled over fresh controllers.
+       This is the control-plane step a canary waits on after its last
+       passing window.
+    3. **Rollback detection windows** — health windows observed until each
+       poison kind (NaN, absolute-latency, calibration, staleness) flips
+       the decision to ``rollback``. Gate evaluation is a pure function of
+       the window, so every breach kind must detect in exactly 1 window —
+       this series is the regression trip-wire for that contract (a value
+       > 1 means a guardrail went soft).
+
+    Honesty fields: ``device_kind`` names the serving chip; ``load_kind``
+    labels the stream (single paced client at ``qps``, not a production
+    trace); ``host_cpu_count`` says how independent the two arms' compute
+    really is on this box — both arms time-slice the same core(s), which
+    INFLATES measured shadow overhead relative to a host with real spare
+    capacity, so the < 10% bar is conservative here."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from deepfm_tpu.serve import ARM_CHALLENGER, ExperimentRouter, \
+        ServingEngine
+    from deepfm_tpu.train import promote as promote_lib
+    from deepfm_tpu.utils import export as export_lib
+
+    cfg = _bench_cfg()
+    tmp = export_serving_artifacts(tempfile.mkdtemp(prefix="bench_exp_"))
+    try:
+        buckets = export_lib.serving_buckets(16)
+        control = ServingEngine(
+            export_lib.load_serving(os.path.join(tmp, "1"),
+                                    buckets=tuple(buckets)),
+            max_batch=16, max_delay_ms=0.5, buckets=buckets)
+        challenger = ServingEngine(
+            export_lib.load_serving(os.path.join(tmp, "2"),
+                                    buckets=tuple(buckets)),
+            max_batch=16, max_delay_ms=25.0, buckets=buckets)
+
+        rng = np.random.default_rng(7)
+        stream = []
+        for rid in range(n_requests):
+            n = int(rng.integers(1, max_req + 1))
+            ids = rng.integers(0, cfg.feature_size,
+                               (n, cfg.field_size)).astype(np.int32)
+            vals = rng.normal(size=(n, cfg.field_size)).astype(np.float32)
+            stream.append((rid, ids, vals))
+        for eng in (control, challenger):    # compile every bucket up front
+            for n in range(1, max_req + 1):
+                eng.predict(np.zeros((n, cfg.field_size), np.int32),
+                            np.zeros((n, cfg.field_size), np.float32),
+                            timeout=60)
+
+        def drive(submit):
+            lat = []
+            t0 = time.monotonic()
+            for i, (rid, ids, vals) in enumerate(stream):
+                wait = t0 + i / qps - time.monotonic()
+                if wait > 0:
+                    time.sleep(wait)
+                s = time.monotonic()
+                submit(rid, ids, vals)
+                lat.append((time.monotonic() - s) * 1000.0)
+            lat.sort()
+            return (lat[len(lat) // 2],
+                    lat[min(len(lat) - 1, int(0.99 * len(lat)))])
+
+        router = ExperimentRouter(control, challenger, mode="shadow",
+                                  seed=7, challenger_permille=permille,
+                                  shadow_slo_ms=0.0)
+        base_p50s, base_p99s, shadow_p50s, shadow_p99s = [], [], [], []
+        for _ in range(rounds):
+            b50, b99 = drive(lambda rid, ids, vals:
+                             control.predict(ids, vals, timeout=30))
+            s50, s99 = drive(lambda rid, ids, vals:
+                             router.predict(ids, vals, rid, timeout=30))
+            base_p50s.append(b50)
+            base_p99s.append(b99)
+            shadow_p50s.append(s50)
+            shadow_p99s.append(s99)
+
+        def med(xs):
+            return round(sorted(xs)[len(xs) // 2], 3)
+        base_p50, base_p99 = med(base_p50s), med(base_p99s)
+        shadow_p50, shadow_p99 = med(shadow_p50s), med(shadow_p99s)
+        shadowed = rounds * sum(1 for rid, _, _ in stream
+                                if router.assign(rid) == ARM_CHALLENGER)
+        deadline = time.monotonic() + 30.0    # drain the side lane before
+        while time.monotonic() < deadline:    # reading its counters
+            s = router.summary()
+            if (s["shadow_completed"] + s["shadow_errors"]
+                    >= s["shadow_submitted"]):
+                break
+            time.sleep(0.01)
+        router_summary = router.summary()
+        router.close()
+        for eng in (control, challenger):
+            eng.close()
+
+        # --- promotion pointer-move latency (control plane, no serving) --
+        gates = promote_lib.GateConfig(
+            min_samples=1, min_auc_delta=-0.05, max_p99_ratio=10.0,
+            max_p99_ms=1000.0, max_nonfinite=0, max_calibration_err=0.25,
+            max_candidate_age_s=3600.0, windows_required=1)
+        healthy = dict(arm=1, n=1000, auc=0.75, p99_latency_ms=5.0,
+                       nonfinite=0, mean_pred=0.5, observed_ctr=0.5,
+                       calibration_err=0.0)
+        ctl_health = dict(healthy, arm=0)
+        promote_ms = []
+        for _ in range(5):
+            export_lib.write_latest(tmp, "1")
+            ctl = promote_lib.PromotionController(tmp, gates=gates)
+            assert ctl.offer("2")
+            t0 = time.monotonic()
+            d = ctl.observe(healthy, ctl_health)
+            promote_ms.append((time.monotonic() - t0) * 1000.0)
+            assert d.action == "promote", d
+        promote_ms.sort()
+
+        # --- rollback detection windows per poison kind ------------------
+        poisons = {
+            "nan": (dict(healthy, nonfinite=7),
+                    promote_lib.REASON_NONFINITE, None),
+            "latency": (dict(healthy, p99_latency_ms=5000.0),
+                        promote_lib.REASON_LATENCY, None),
+            "calibration": (dict(healthy, mean_pred=0.9,
+                                 calibration_err=0.4),
+                            promote_lib.REASON_CALIBRATION, None),
+            "stale": (healthy, promote_lib.REASON_STALE, 7200.0),
+        }
+        detection = {}
+        for kind, (health, reason, age_s) in poisons.items():
+            ctl = promote_lib.PromotionController(tmp, gates=gates)
+            assert ctl.offer("1", now_s=0.0 if age_s is not None else None)
+            windows = 0
+            while True:
+                windows += 1
+                kw = {"now_s": age_s} if age_s is not None else {}
+                d = ctl.observe(health, ctl_health, **kw)
+                if d.action == "rollback":
+                    break
+                assert windows < 10, f"{kind} never detected"
+            detection[kind] = {"windows": windows,
+                               "reason_typed": reason in d.reasons}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "requests_per_round": n_requests,
+        "rounds": rounds,
+        "offered_qps": qps,
+        "challenger_permille": permille,
+        "shadow_duplicated": shadowed,
+        "baseline_p50_ms": base_p50,
+        "baseline_p99_ms": base_p99,
+        "shadow_p50_ms": shadow_p50,
+        "shadow_p99_ms": shadow_p99,
+        "baseline_p99_ms_rounds": [round(x, 3) for x in base_p99s],
+        "shadow_p99_ms_rounds": [round(x, 3) for x in shadow_p99s],
+        "shadow_p99_overhead_pct": round(
+            (shadow_p99 - base_p99) / base_p99 * 100.0, 2)
+        if base_p99 > 0 else None,
+        "shadow_errors": router_summary["shadow_errors"],
+        "shadow_nonfinite": router_summary["shadow_nonfinite"],
+        "promotion_pointer_move_p50_ms": round(
+            promote_ms[len(promote_ms) // 2], 3),
+        "promotion_pointer_move_max_ms": round(promote_ms[-1], 3),
+        "rollback_detection": detection,
+        "load_kind": "synthetic-open-loop-paced-median-of-rounds",
+        "device_kind": jax.devices()[0].device_kind,
+        "host_cpu_count": os.cpu_count(),
+    }
+
+
 #: Fleet shape shared by the saturation probe and every flood point — a
 #: deliberately SMALL queue (512 rows -> 256-row shed watermark) so the
 #: post-window drain stays short and the admission gate, not the queue
@@ -1413,6 +1610,12 @@ def main() -> None:
         overload = {"error": str(e)}
 
     try:
+        experiment = experiment_series()
+    except Exception as e:
+        print(f"bench: experiment series error: {e}", file=sys.stderr)
+        experiment = {"error": str(e)}
+
+    try:
         multitask = multitask_series()
     except Exception as e:
         print(f"bench: multitask series error: {e}", file=sys.stderr)
@@ -1476,6 +1679,7 @@ def main() -> None:
         "online_publish": online_publish,
         "serving": serving,
         "overload": overload,
+        "experiment": experiment,
         "multitask": multitask,
         "cascade": cascade,
         "production_day": production_day,

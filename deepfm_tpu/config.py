@@ -254,6 +254,42 @@ class Config:
     # cascade first shrinks retrieve_k to this, then skips the ranker and
     # serves retrieval order. 0 disables the degradation ladder.
     degrade_retrieve_k: int = 0
+    # ---- experimentation plane (serve/experiment.py + train/promote.py;
+    # README "Experimentation & gated deployment", TUNING §2.19) ----
+    # Traffic-split mode in front of the engine: off (single-arm), shadow
+    # (challenger duplicated on an isolated side lane, response never
+    # returned), canary (small live slice with an instant kill-switch), ab
+    # (live split). Any mode but off needs a challenger artifact.
+    experiment_mode: str = "off"
+    # Seed of the pure hash-split arm assignment — same seed, same request
+    # ids, same split, bit-for-bit (the replayability contract).
+    experiment_seed: int = 0
+    # Challenger traffic share in permille (0-1000), so a 0.5% canary (5)
+    # is expressible. In shadow mode this is the duplication rate.
+    experiment_permille: int = 50
+    # Shadow-lane latency SLO in ms: a shadow response slower than this is
+    # counted (shadow_slo_misses) — never waited on. 0 disables the count.
+    experiment_shadow_slo_ms: float = 0.0
+    # Promotion gates (train/promote.py): a candidate must pass EVERY gate
+    # for this many consecutive health windows before LATEST advances; one
+    # breach rolls it back; two failed candidacies quarantine the version.
+    experiment_gate_windows: int = 2
+    # Minimum per-arm samples for a window to be judged at all (thinner
+    # windows hold — they neither advance nor demote).
+    experiment_min_samples: int = 50
+    # Gate thresholds: challenger AUC may trail control by at most
+    # -min_auc_delta; challenger p99 must stay within max_p99_ratio x
+    # control p99 AND under the absolute max_p99_ms ceiling (0 = off);
+    # more than max_nonfinite NaN/Inf predictions is a
+    # breach; |mean predicted - observed CTR| must stay under
+    # max_calibration_err; a candidate older than max_candidate_age_s
+    # (0 = off) breaches the staleness gate.
+    experiment_min_auc_delta: float = -0.02
+    experiment_max_p99_ratio: float = 1.5
+    experiment_max_p99_ms: float = 0.0
+    experiment_max_nonfinite: int = 0
+    experiment_max_calibration_err: float = 0.2
+    experiment_max_candidate_age_s: float = 0.0
 
     # ---- mesh / parallelism (replaces TF_CONFIG + horovod knobs) ----
     mesh_data: int = 0                # data-parallel axis size (0 = all devices)
@@ -522,6 +558,43 @@ class Config:
         if self.degrade_retrieve_k < 0:
             raise ValueError(
                 "degrade_retrieve_k must be >= 0 (0 disables the ladder)")
+        if self.experiment_mode not in ("off", "shadow", "canary", "ab"):
+            raise ValueError(
+                f"experiment_mode must be off|shadow|canary|ab, got "
+                f"{self.experiment_mode!r}")
+        if not 0 <= self.experiment_permille <= 1000:
+            raise ValueError(
+                f"experiment_permille must be in 0..1000, got "
+                f"{self.experiment_permille}")
+        if self.experiment_shadow_slo_ms < 0:
+            raise ValueError(
+                "experiment_shadow_slo_ms must be >= 0 (0 disables)")
+        if self.experiment_gate_windows < 1:
+            raise ValueError(
+                f"experiment_gate_windows must be >= 1, got "
+                f"{self.experiment_gate_windows}")
+        if self.experiment_min_samples < 1:
+            raise ValueError(
+                f"experiment_min_samples must be >= 1, got "
+                f"{self.experiment_min_samples}")
+        if self.experiment_max_p99_ratio <= 0:
+            raise ValueError(
+                f"experiment_max_p99_ratio must be > 0, got "
+                f"{self.experiment_max_p99_ratio}")
+        if self.experiment_max_p99_ms < 0:
+            raise ValueError(
+                "experiment_max_p99_ms must be >= 0 (0 disables)")
+        if self.experiment_max_nonfinite < 0:
+            raise ValueError(
+                f"experiment_max_nonfinite must be >= 0, got "
+                f"{self.experiment_max_nonfinite}")
+        if self.experiment_max_calibration_err < 0:
+            raise ValueError(
+                f"experiment_max_calibration_err must be >= 0, got "
+                f"{self.experiment_max_calibration_err}")
+        if self.experiment_max_candidate_age_s < 0:
+            raise ValueError(
+                "experiment_max_candidate_age_s must be >= 0 (0 disables)")
         bucket_sizes = self.serve_bucket_sizes
         if any(b < 1 for b in bucket_sizes):
             raise ValueError(

@@ -32,7 +32,7 @@ touch a device.
 from .health import LoopHealth
 from .impressions import ImpressionLogger, iter_impressions
 from .join import DelayedLabelJoiner, SeededLabelFeed
-from .metrics import staleness_summary, windowed_auc
+from .metrics import arm_health, staleness_summary, windowed_auc
 from .skew import SkewChecker
 from .traffic import DiurnalTrafficPlan
 
@@ -43,6 +43,7 @@ __all__ = [
     "LoopHealth",
     "SeededLabelFeed",
     "SkewChecker",
+    "arm_health",
     "iter_impressions",
     "staleness_summary",
     "windowed_auc",

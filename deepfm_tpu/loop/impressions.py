@@ -22,6 +22,15 @@ request's correlation id) and ``model_version`` (the publish version that
 scored the row). Both are optional int64 keys — ``decode_impression`` reads
 only the required keys, and the joiner re-encodes just label/ids/values, so
 stamped shards stay byte-compatible downstream.
+
+Experimentation (serve.experiment): two more optional keys ride the same
+pattern — ``arm`` (int64: 0 control / 1 challenger, the traffic-split arm
+that produced the row; shadow-lane challenger responses are logged under
+their own impression ids with arm=1) and ``pred`` (float32: the probability
+the arm's model served). ``pred`` is what makes per-arm health replayable
+from the log alone: offline recomputation of AUC/calibration from
+(arm, pred, joined label) must match the online accumulation bit-exactly,
+no model re-run required.
 """
 
 from __future__ import annotations
@@ -38,12 +47,16 @@ IMPRESSION_ID_KEY = "impression_id"
 SERVED_AT_KEY = "served_at_us"
 TRACE_ID_KEY = "trace_id"
 MODEL_VERSION_KEY = "model_version"
+ARM_KEY = "arm"
+PRED_KEY = "pred"
 
 
 def encode_impression(impression_id: int, served_at_s: float,
                       ids: np.ndarray, vals: np.ndarray, *,
                       trace_id: Optional[int] = None,
-                      model_version: Optional[int] = None) -> bytes:
+                      model_version: Optional[int] = None,
+                      arm: Optional[int] = None,
+                      pred: Optional[float] = None) -> bytes:
     features = {
         example_codec.LABEL_KEY: (np.asarray([0.0], np.float32), "float"),
         example_codec.IDS_KEY: (np.asarray(ids, np.int64), "int64"),
@@ -59,6 +72,10 @@ def encode_impression(impression_id: int, served_at_s: float,
     if model_version is not None:
         features[MODEL_VERSION_KEY] = (
             np.asarray([int(model_version)], np.int64), "int64")
+    if arm is not None:
+        features[ARM_KEY] = (np.asarray([int(arm)], np.int64), "int64")
+    if pred is not None:
+        features[PRED_KEY] = (np.asarray([pred], np.float32), "float")
     return example_codec.encode_example(features)
 
 
@@ -71,6 +88,19 @@ def read_correlation(buf: bytes) -> Tuple[Optional[int], Optional[int]]:
         entry = feats.get(key)
         out.append(None if entry is None else int(np.asarray(entry[1])[0]))
     return out[0], out[1]
+
+
+def read_experiment(buf: bytes) -> Tuple[Optional[int], Optional[float]]:
+    """-> (arm, pred) of one impression record (None when the writer did
+    not stamp them). ``pred`` comes back as the float32 the arm served —
+    the exact value per-arm health recomputation must use."""
+    feats = example_codec.decode_example(buf)
+    arm_entry = feats.get(ARM_KEY)
+    pred_entry = feats.get(PRED_KEY)
+    arm = None if arm_entry is None else int(np.asarray(arm_entry[1])[0])
+    pred = (None if pred_entry is None
+            else float(np.asarray(pred_entry[1], np.float32)[0]))
+    return arm, pred
 
 
 def decode_impression(buf: bytes) -> Tuple[int, float, np.ndarray, np.ndarray]:
@@ -132,7 +162,9 @@ class ImpressionLogger:
 
     def log(self, impression_id: int, ids: np.ndarray, vals: np.ndarray,
             served_at_s: float, *, trace_id: Optional[int] = None,
-            model_version: Optional[int] = None) -> None:
+            model_version: Optional[int] = None,
+            arm: Optional[int] = None,
+            pred: Optional[float] = None) -> None:
         """Log one served row. ``ids``/``vals`` are the arrays the engine
         scored ([F], any integer/float32 dtype)."""
         if self._writer is None:
@@ -143,7 +175,8 @@ class ImpressionLogger:
         self._writer.write(
             encode_impression(impression_id, served_at_s, ids, vals,
                               trace_id=trace_id,
-                              model_version=model_version))
+                              model_version=model_version,
+                              arm=arm, pred=pred))
         self._in_shard += 1
         self.health.record("impressions_logged")
         if self._in_shard >= self._shard_records:
@@ -152,16 +185,22 @@ class ImpressionLogger:
     def log_request(self, first_id: int, ids: np.ndarray, vals: np.ndarray,
                     served_at_s: float, *,
                     trace_id: Optional[int] = None,
-                    model_version: Optional[int] = None) -> List[int]:
+                    model_version: Optional[int] = None,
+                    arm: Optional[int] = None,
+                    preds: Optional[np.ndarray] = None) -> List[int]:
         """Log every row of one request ``(ids[n,F], vals[n,F])`` with
         consecutive impression ids starting at ``first_id``; returns them.
-        ``trace_id``/``model_version`` stamp every row of the request (the
-        engine resolves one model version per flush)."""
+        ``trace_id``/``model_version``/``arm`` stamp every row of the
+        request (the engine resolves one model version per flush; the
+        router one arm per request); ``preds`` ([n] probabilities) stamps
+        each row with the probability its arm served."""
         out = []
         for r in range(int(ids.shape[0])):
             iid = int(first_id) + r
             self.log(iid, ids[r], vals[r], served_at_s,
-                     trace_id=trace_id, model_version=model_version)
+                     trace_id=trace_id, model_version=model_version,
+                     arm=arm,
+                     pred=None if preds is None else float(preds[r]))
             out.append(iid)
         return out
 

@@ -67,6 +67,57 @@ def windowed_auc(samples: List[Tuple[float, float, float, float]],
     return out
 
 
+def arm_health(samples: Sequence[Tuple[int, float, float, float]]
+               ) -> Dict[int, Dict[str, Any]]:
+    """Per-arm guardrail metrics over ``(arm, label, prob, latency_ms)``
+    samples — the health window the promotion controller judges
+    (``train.promote.evaluate_gates``).
+
+    Per arm: ``n``, ``auc`` (exact, None on a one-class window),
+    ``p99_latency_ms``, ``nonfinite`` (count of NaN/Inf probs — those rows
+    are EXCLUDED from auc/calibration so one poisoned prediction cannot
+    also poison the other gates), ``mean_pred`` / ``observed_ctr`` /
+    ``calibration_err`` (|mean predicted − observed CTR|).
+
+    Deterministic and representation-stable: inputs are cast to float64
+    from whatever the caller logged (the impression log stamps float32
+    preds), sums run in sorted-sample order as given, and every reported
+    float is rounded — so the online accumulation and a pure offline
+    recomputation from the impression log produce bit-identical dicts.
+    """
+    by_arm: Dict[int, List[Tuple[float, float, float]]] = {}
+    for arm, label, prob, latency_ms in samples:
+        by_arm.setdefault(int(arm), []).append(
+            (float(label), float(prob), float(latency_ms)))
+    out: Dict[int, Dict[str, Any]] = {}
+    for arm in sorted(by_arm):
+        rows = by_arm[arm]
+        probs = np.asarray([r[1] for r in rows], np.float64)
+        labels = np.asarray([r[0] for r in rows], np.float64)
+        lats = [r[2] for r in rows]
+        finite = np.isfinite(probs)
+        nonfinite = int(probs.size - int(finite.sum()))
+        fp, fl = probs[finite], labels[finite]
+        auc = exact_auc(fp, fl) if fp.size else float("nan")
+        mean_pred = float(fp.mean()) if fp.size else None
+        ctr = float(fl.mean()) if fl.size else None
+        p99 = percentile(lats, 99)
+        out[arm] = {
+            "arm": arm,
+            "n": len(rows),
+            "auc": round(auc, 4) if auc == auc else None,
+            "p99_latency_ms": round(p99, 3) if p99 is not None else None,
+            "nonfinite": nonfinite,
+            "mean_pred": (round(mean_pred, 6)
+                          if mean_pred is not None else None),
+            "observed_ctr": round(ctr, 6) if ctr is not None else None,
+            "calibration_err": (round(abs(mean_pred - ctr), 6)
+                                if mean_pred is not None and ctr is not None
+                                else None),
+        }
+    return out
+
+
 def percentile(values: Sequence[float], q: float) -> Optional[float]:
     if not len(values):
         return None

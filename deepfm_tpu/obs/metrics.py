@@ -36,6 +36,8 @@ _KIND_METHOD = {
     "host_stage": "ns_per_record",  # utils.profiling.HostStageStats
     "publisher": "stats",           # train.publish.Publisher
     "loop_health": "snapshot",      # loop.health.LoopHealth
+    "experiment": "summary",        # serve.experiment.ExperimentRouter
+    "promotion": "stats",           # train.promote.PromotionController
 }
 
 

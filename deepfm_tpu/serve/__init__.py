@@ -10,6 +10,10 @@ Layering (heaviest import last — clients can use :mod:`.frontend` and
   * :mod:`.engine` — bounded queue, dynamic batcher, bucketed predict,
     response demux, hot swap via ``utils.export.LatestWatcher`` (the jax
     import happens lazily at engine construction).
+  * :mod:`.experiment` — traffic-split router (A/B, shadow, canary) with
+    pure hash-split arm assignment, shadow-lane isolation, and the canary
+    kill-switch; jax-free (pairs with ``train.promote`` for gated
+    deployment).
   * :mod:`.replicas` — N engine replicas behind one submit surface: sticky
     client-affinity routing with least-loaded spill, staggered per-replica
     hot swap, fleet-aggregate stats.
@@ -20,15 +24,20 @@ Layering (heaviest import last — clients can use :mod:`.frontend` and
 from .admission import (VALUE_CLASSES, VALUE_DEFAULT, AdmissionController,
                         AdmissionShed, DegradationLadder, HysteresisLadder)
 from .engine import ServeFuture, ServeTimeout, ServerOverloaded, ServingEngine
+from .experiment import (ARM_CHALLENGER, ARM_CONTROL, ExperimentRouter,
+                         assign_arm)
 from .frontend import (FrontendHandle, FrontendServer, ServingClient,
                        client_main)
 from .replicas import HedgedFuture, ReplicatedEngine
 from .stats import ServingStats, aggregate_summary
 
 __all__ = [
+    "ARM_CHALLENGER",
+    "ARM_CONTROL",
     "AdmissionController",
     "AdmissionShed",
     "DegradationLadder",
+    "ExperimentRouter",
     "FrontendHandle",
     "FrontendServer",
     "HedgedFuture",
@@ -43,5 +52,6 @@ __all__ = [
     "VALUE_CLASSES",
     "VALUE_DEFAULT",
     "aggregate_summary",
+    "assign_arm",
     "client_main",
 ]

@@ -90,8 +90,9 @@ class ServeFuture:
     """
 
     __slots__ = ("ids", "vals", "n", "lane", "value", "t_enqueue",
-                 "latency_ms", "trace_id", "model_version", "_event",
-                 "_probs", "_error", "_lock", "_callbacks", "_cancelled")
+                 "latency_ms", "trace_id", "model_version", "arm",
+                 "_event", "_probs", "_error", "_lock", "_callbacks",
+                 "_cancelled")
 
     def __init__(self, ids: np.ndarray, vals: np.ndarray, t_enqueue: float,
                  lane: str = LANE_LARGE, trace_id: Optional[int] = None,
@@ -105,6 +106,7 @@ class ServeFuture:
         self.latency_ms: Optional[float] = None
         self.trace_id = trace_id            # correlation id (obs.trace)
         self.model_version: Optional[int] = None  # stamped by the flush
+        self.arm: Optional[int] = None      # stamped by ExperimentRouter
         self._event = threading.Event()
         self._probs: Optional[np.ndarray] = None
         self._error: Optional[BaseException] = None

@@ -207,7 +207,15 @@ class ServingStats:
             if (self._first_done is not None and self._last_done is not None
                     and self._last_done > self._first_done):
                 window = self._last_done - self._first_done
-            qps = (self.requests_completed / window if window else None)
+            # Zero completed requests is a VALID summary (a fleet that
+            # served nothing — e.g. a challenger replica behind a 0% split
+            # or a drained canary): 0 QPS, None percentiles, no raise. None
+            # QPS is reserved for "requests exist but the window is
+            # degenerate" (a single completion instant).
+            if window:
+                qps = self.requests_completed / window
+            else:
+                qps = 0.0 if self.requests_completed == 0 else None
             occupancy = (100.0 * self.real_rows / self.padded_rows
                          if self.padded_rows else None)
             small = self.lane_latencies_ms.get(LANE_SMALL, [])
@@ -263,6 +271,11 @@ def aggregate_summary(stats: Sequence[ServingStats]) -> Dict[str, Any]:
     staggered swaps mean the FLEET never sees them all at once — that claim
     lives with the swap coordinator, not here).
     """
+    # Materialize first: a generator argument would be consumed by the
+    # accumulation loop and then re-counted as replicas=0 below (and an
+    # EMPTY fleet — or one that served nothing — must still summarize to
+    # 0 QPS / None percentiles, never raise).
+    stats = list(stats)
     lat: List[float] = []
     small: List[float] = []
     large: List[float] = []
@@ -316,11 +329,14 @@ def aggregate_summary(stats: Sequence[ServingStats]) -> Dict[str, Any]:
     if (first_done is not None and last_done is not None
             and last_done > first_done):
         window = last_done - first_done
-    qps = totals["serving_requests"] / window if window else None
+    if window:
+        qps = totals["serving_requests"] / window
+    else:
+        qps = 0.0 if totals["serving_requests"] == 0 else None
     known_blackouts = [b for b in blackout if b is not None]
     out = dict(totals)
     out.update({
-        "replicas": len(list(stats)),
+        "replicas": len(stats),
         "serving_p50_ms": _pct(lat, 50),
         "serving_p99_ms": _pct(lat, 99),
         "serving_small_requests": len(small),

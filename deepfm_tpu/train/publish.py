@@ -197,7 +197,10 @@ class Publisher:
 
     def _advance_latest(self, version: str) -> None:
         """Move LATEST forward, never backwards: a resumed run republishing
-        an old cadence step must not regress the serving pointer."""
+        an old cadence step must not regress the serving pointer. Every
+        actual move is recorded in the ``pointer_history.jsonl`` sidecar
+        BEFORE the pointer write — a crash between the two heals on the
+        retried publish because the append is tail-deduplicated."""
         current = export_lib.read_latest(self._dir)
         if current is not None:
             try:
@@ -205,7 +208,13 @@ class Publisher:
                     return
             except ValueError:
                 pass  # non-numeric current pointer: overwrite it
+        export_lib.append_pointer_event(self._dir, version, "publish")
+        faults_lib.check_publish_crash("after_history_before_latest")
         export_lib.write_latest(self._dir, version)
+
+    def history(self) -> List[Dict[str, Any]]:
+        """The publish dir's pointer-history sidecar, oldest first."""
+        return export_lib.pointer_history(self._dir)
 
     # ------------------------------------------------------------ lifecycle
 

@@ -437,6 +437,70 @@ def read_latest(publish_dir: str) -> Optional[str]:
     return path if fileio.exists(path) else None
 
 
+# Append-only audit sidecar next to LATEST: one JSON line per pointer move
+# (publish / promote / rollback, plus quarantine bookkeeping), so every
+# deployment decision is replayable from the publish dir alone.
+POINTER_HISTORY_FILE = "pointer_history.jsonl"
+
+
+def append_pointer_event(publish_dir: str, version: str, actor: str,
+                         reason: str = "", *,
+                         wall_time: Optional[float] = None) -> Dict[str, Any]:
+    """Append one pointer-history event; returns the entry written (or the
+    existing tail entry when this is a replay).
+
+    Idempotent by design: the write protocol everywhere in this repo is
+    *append history, then move the pointer* — a crash between the two means
+    the healing retry re-runs both steps, so an append whose
+    ``(version, actor, reason)`` exactly matches the current tail entry is
+    skipped instead of duplicated. ``wall_time`` is injectable (the drill
+    passes its logical clock; audit fingerprints exclude it either way).
+    """
+    entry = {"version": str(version), "actor": str(actor),
+             "reason": str(reason),
+             "wall_time": float(wall_time if wall_time is not None
+                                else time.time())}
+    path = fileio.join(publish_dir, POINTER_HISTORY_FILE)
+    history = pointer_history(publish_dir)
+    if history:
+        tail = history[-1]
+        if (tail.get("version") == entry["version"]
+                and tail.get("actor") == entry["actor"]
+                and tail.get("reason") == entry["reason"]):
+            return tail
+    with open(path, "a", encoding="utf-8") as f:
+        f.write(json.dumps(entry, sort_keys=True,
+                           separators=(",", ":")) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    return entry
+
+
+def pointer_history(publish_dir: str) -> list:
+    """All pointer-history events, oldest first. Tolerant of a torn final
+    line (a crash mid-append): the unparseable tail is dropped, matching
+    the heal contract — the retried append rewrites it whole."""
+    path = fileio.join(publish_dir, POINTER_HISTORY_FILE)
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                break   # torn tail — everything after it is suspect
+    return out
+
+
+# The pointer reader's companion (satellite contract: reading the pointer
+# and reading its provenance are one surface).
+read_latest.history = pointer_history
+
+
 class LatestWatcher:
     """Hot-swap serving consumer: follow ``LATEST`` without dropping requests.
 

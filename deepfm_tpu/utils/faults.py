@@ -442,7 +442,14 @@ class ChaosSchedule:
                      "fault_after_steps", "hold_after_steps")
     # executor_slow is driver-side: the drill process owns the serving
     # engine, so it arms set_executor_slow() itself when the event is due.
-    DRIVER_KINDS = ("preempt", "executor_slow")
+    # The challenger_* kinds poison the experimentation plane's candidate
+    # model (gated deployment drill): driver-side too, because the drill
+    # owns the candidate build — challenger_nan arms set_nan_plan() on the
+    # candidate trainer (params go NaN through the real batch-poison seam),
+    # challenger_stale freezes the candidate at stale params, and
+    # challenger_slow delays only the challenger engine's predicts.
+    DRIVER_KINDS = ("preempt", "executor_slow", "challenger_nan",
+                    "challenger_stale", "challenger_slow")
     #: kinds that must fire once per drill, not once per process start
     ONESHOT_KINDS = ("publish_crash", "cold_fetch", "nan_batches")
     KINDS = PROCESS_KINDS + DRIVER_KINDS
@@ -467,7 +474,13 @@ class ChaosSchedule:
                  nan_batches: int = 0,
                  executor_slow_events: int = 0,
                  executor_slow_ms: float = 0.0,
-                 executor_slow_calls: int = 0) -> "ChaosSchedule":
+                 executor_slow_calls: int = 0,
+                 challenger_nan_events: int = 0,
+                 challenger_nan_batches: int = 3,
+                 challenger_stale_events: int = 0,
+                 challenger_slow_events: int = 0,
+                 challenger_slow_ms: float = 0.0,
+                 challenger_slow_calls: int = 0) -> "ChaosSchedule":
         """Draw a plan for a drill of ``horizon_s`` seconds. Event times
         land in the middle 20-80% of the horizon (chaos during steady
         state, not during come-up or drain). stdlib ``random`` on purpose:
@@ -500,6 +513,23 @@ class ChaosSchedule:
                 rng.uniform(0.2, 0.5) * horizon_s, "executor_slow",
                 delay_ms=round(float(executor_slow_ms), 3),
                 calls=int(executor_slow_calls)))
+        # Challenger poisoning (experimentation drill). New draws come
+        # AFTER every existing kind's, so schedules generated with only the
+        # old parameters stay bit-identical to what they always were.
+        for _ in range(int(challenger_nan_events)):
+            batches = sorted(rng.sample(range(0, 20),
+                                        int(challenger_nan_batches)))
+            events.append(ChaosEvent.make(
+                rng.uniform(0.2, 0.8) * horizon_s, "challenger_nan",
+                batches=tuple(batches)))
+        for _ in range(int(challenger_stale_events)):
+            events.append(ChaosEvent.make(
+                rng.uniform(0.2, 0.8) * horizon_s, "challenger_stale"))
+        for _ in range(int(challenger_slow_events)):
+            events.append(ChaosEvent.make(
+                rng.uniform(0.2, 0.8) * horizon_s, "challenger_slow",
+                delay_ms=round(float(challenger_slow_ms), 3),
+                calls=int(challenger_slow_calls)))
         return cls(events, seed=int(seed))
 
     # -- serialization --------------------------------------------------

@@ -98,6 +98,33 @@ OVERLOAD = dict(duration_s=3.0, offered_qps=110.0, users=1_000_000,
                 slo_ms=250.0, workers=12, slow_ms=45.0, slow_calls=30,
                 timeout_s=12.0)
 
+# Experimentation drill (gated deployment): window sizes are tuned so every
+# health window clears min_samples deterministically at the default seed.
+# The latency guardrail is the ABSOLUTE p99 ceiling (max_p99_ms): on a
+# 1-core drill host the control's own tail is timing noise, so the ratio
+# gate is parked out of the way (1e6) and detection rests on the ceiling —
+# the degraded challenger's injected sleep exceeds it BY CONSTRUCTION,
+# while a healthy warm challenger sits ~20x under it. AUC/calibration
+# tolerances are lenient because both arms see a few dozen synthetic rows
+# per window; the unit tests pin the tight-threshold behaviour.
+EXPERIMENT = dict(duration_s=12.0, base_qps=20.0, peak_qps=20.0,
+                  max_rows=4, window_requests=18, permille=600,
+                  min_samples=8, min_auc_delta=-0.35,
+                  max_p99_ratio=1e6, max_p99_ms=150.0,
+                  max_calibration_err=0.75, max_candidate_age_s=120.0,
+                  windows_required=2, shadow_slo_ms=60.0,
+                  slow_ms=250.0, stale_age_s=600.0,
+                  train_steps=4, nan_train_batches=20,
+                  serve_max_batch=16, serve_max_delay_ms=1.0)
+# Tier-1 smoke overrides: fewer requests per window, shorter injected sleep.
+EXPERIMENT_SMOKE = dict(duration_s=8.0, window_requests=8, min_samples=4,
+                        train_steps=3, slow_ms=200.0)
+
+#: Shadow-lane impressions log the SAME served row under the challenger
+#: arm; offsetting the impression id keeps the log's ids unique while the
+#: original id (and its label) stays recoverable by modulus.
+SHADOW_IID_OFFSET = 1 << 20
+
 
 def _say_factory(verbose):
     return (lambda msg: print(f"[production_drill] {msg}", flush=True)) \
@@ -801,6 +828,10 @@ def run_drill(workdir, *, seed=2026, pace=1.0, report_path=None,
     say("overload drill (degradation ladder under executor_slow)")
     report["overload"] = run_overload_drill(
         os.path.join(workdir, "overload"), seed=seed, verbose=verbose)
+    say("experimentation drill (gated deployment: shadow/canary/promote/"
+        "rollback)")
+    report["experiment"] = run_experiment_drill(
+        os.path.join(workdir, "experiment"), seed=seed, verbose=verbose)
     if report_path is None:
         report_path = _next_report_path()
     if report_path:
@@ -1037,6 +1068,469 @@ def run_overload_drill(workdir, *, seed=7, verbose=False,
     finally:
         os.environ.pop("DEEPFM_TPU_SKIP_TF_EXPORT", None)
         faults_lib.set_executor_slow(0.0, 0)
+
+
+def _experiment_batches(plan, batch_size, count):
+    """Deterministic training batches built by cycling the traffic plan's
+    rows — candidates train on the same distribution they are judged on,
+    and the batch stream is a pure function of the plan's seed."""
+    ids_rows, vals_rows, y_rows = [], [], []
+    for req in plan.requests:
+        for r in range(int(req.ids.shape[0])):
+            ids_rows.append(np.asarray(req.ids[r], np.int32))
+            vals_rows.append(np.asarray(req.vals[r], np.float32))
+            y_rows.append(float(req.labels[r]))
+    repeats = -(-(batch_size * count) // len(y_rows))
+    ids_rows *= repeats
+    vals_rows *= repeats
+    y_rows *= repeats
+    out = []
+    for b in range(count):
+        sl = slice(b * batch_size, (b + 1) * batch_size)
+        out.append({
+            "label": np.asarray(y_rows[sl], np.float32).reshape(
+                batch_size, 1),
+            "feat_ids": np.stack(ids_rows[sl]),
+            "feat_vals": np.stack(vals_rows[sl]),
+        })
+    return out
+
+
+def _train_candidate(trainer, batches):
+    """Fresh init, a few real train steps over ``batches`` (a list or any
+    iterable — the NaN scenario passes a ``BatchPoisoner`` wrapper)."""
+    state = trainer.init_state()
+    step_fn = trainer._make_train_step()
+    for b in batches:
+        state, _ = step_fn(state, trainer.put_batch(b))
+    return state
+
+
+def run_experiment_drill(workdir, *, seed=7, verbose=False, params=None):
+    """Gated-deployment drill: shadow-validate, canary, and auto-promote a
+    healthy challenger, then detect / roll back / quarantine a NaN-poisoned,
+    a latency-degraded, and a stale-frozen challenger — with ZERO dropped or
+    failed primary-lane requests throughout.
+
+    The closed loop is fully serialized (each request's primary AND shadow
+    resolution completes before the next submit), so every prediction — and
+    therefore every gate decision, pointer move, and the audit fingerprint —
+    is a pure function of the seed: same seed + schedule => identical
+    ``audit_fingerprint``. Wall-clock latencies drive only the absolute-p99
+    guardrail, whose breach/pass margins are structural (an injected sleep
+    above the ceiling vs a warm engine ~20x under it), never the
+    fingerprint. Per-arm health recomputed offline from the impression log
+    (arm + stamped float32 prediction + the plan's labels) must match the
+    online accumulation bit-exactly."""
+    say = _say_factory(verbose)
+    P = dict(EXPERIMENT)
+    P.update(params or {})
+    from deepfm_tpu.data import tfrecord
+    from deepfm_tpu.loop import arm_health
+    from deepfm_tpu.loop import impressions as impressions_lib
+    from deepfm_tpu.serve.experiment import (ARM_CHALLENGER, ARM_CONTROL,
+                                             ExperimentRouter)
+    from deepfm_tpu.train import promote as promote_lib
+
+    t_start = time.time()
+    os.environ["DEEPFM_TPU_SKIP_TF_EXPORT"] = "1"
+    engines = []
+    try:
+        os.makedirs(workdir, exist_ok=True)
+        publish_dir = os.path.join(workdir, "publish")
+        imp_dir = os.path.join(workdir, "impressions")
+
+        schedule = faults_lib.ChaosSchedule.generate(
+            seed, horizon_s=P["duration_s"],
+            challenger_nan_events=1, challenger_nan_batches=2,
+            challenger_slow_events=1, challenger_slow_ms=P["slow_ms"],
+            challenger_stale_events=1)
+        say(f"chaos {schedule.fingerprint()}: "
+            + ", ".join(f"{e.kind}@{e.at_s:g}s" for e in schedule.events))
+        plan = DiurnalTrafficPlan(
+            seed, duration_s=P["duration_s"], base_qps=P["base_qps"],
+            peak_qps=P["peak_qps"], feature_size=FEATURE_SIZE,
+            field_size=FIELD_SIZE, max_rows=P["max_rows"])
+        need = (4 * P["windows_required"]) * P["window_requests"] + 4
+        assert len(plan.requests) >= need, (
+            f"plan supplies {len(plan.requests)} requests, drill needs "
+            f"{need}; raise duration_s/base_qps")
+
+        cfg = Config(feature_size=FEATURE_SIZE, field_size=FIELD_SIZE,
+                     embedding_size=4, deep_layers="8", dropout="1.0",
+                     batch_size=16, compute_dtype="float32", mesh_data=1,
+                     log_steps=0, seed=seed, scale_lr_by_world=False)
+        _bootstrap_v0(cfg, publish_dir, say)   # LATEST -> 0 (+history line)
+
+        # ---- candidate builds (what poisons exist, and their arguments,
+        # come from the chaos schedule) --------------------------------
+        trainer = Trainer(cfg)
+        batches = _experiment_batches(plan, cfg.batch_size,
+                                      P["nan_train_batches"])
+        state1 = _train_candidate(trainer, batches[:P["train_steps"]])
+        export_lib.export_serving(trainer.model, state1, cfg,
+                                  os.path.join(publish_dir, "1"))
+        say("candidate v1 (healthy) exported")
+
+        scenarios = []    # (kind, version, expected breach reason)
+        slow_delay_s = 0.0
+        nan_poisoned = 0
+        fired = set()
+        for ev in schedule.due(P["duration_s"] + 1.0, fired):
+            if ev.kind == "challenger_nan":
+                # The REAL numerical-fault seam: arm the plan, take it the
+                # way the train task would, wrap the candidate's pipeline —
+                # the candidate's params genuinely go NaN through training.
+                faults_lib.set_nan_plan(ev.get("batches"))
+                nan_plan = faults_lib.take_nan_plan()
+                poisoner = faults_lib.BatchPoisoner(
+                    batches, batches=nan_plan["batches"],
+                    value=nan_plan["value"], key=nan_plan["key"])
+                state2 = _train_candidate(trainer, poisoner)
+                nan_poisoned = poisoner.poisoned
+                export_lib.export_serving(trainer.model, state2, cfg,
+                                          os.path.join(publish_dir, "2"))
+                say(f"candidate v2 (NaN-poisoned, {nan_poisoned} batches "
+                    f"via set_nan_plan) exported")
+                scenarios.append(("challenger_nan", "2",
+                                  promote_lib.REASON_NONFINITE))
+            elif ev.kind == "challenger_slow":
+                # v3 = v1's params behind a degraded engine: only the
+                # challenger's predicts are delayed, never the primary's.
+                slow_delay_s = float(ev.get("delay_ms", 0.0)) / 1000.0
+                scenarios.append(("challenger_slow", "3",
+                                  promote_lib.REASON_LATENCY))
+            elif ev.kind == "challenger_stale":
+                # v4 = a frozen candidate that stopped refreshing; the
+                # staleness gate judges its age alone, so it needs no
+                # artifact and no traffic.
+                scenarios.append(("challenger_stale", "4",
+                                  promote_lib.REASON_STALE))
+        assert nan_poisoned >= 1, "nan poison seam never fired"
+        assert slow_delay_s * 1000.0 > P["max_p99_ms"], (
+            f"slow_ms {slow_delay_s * 1e3} must exceed the max_p99_ms "
+            f"ceiling {P['max_p99_ms']} for detection-by-construction")
+
+        # ---- engines ---------------------------------------------------
+        buckets = export_lib.serving_buckets(P["serve_max_batch"])
+        ekw = dict(max_batch=P["serve_max_batch"],
+                   max_delay_ms=P["serve_max_delay_ms"], buckets=buckets)
+        control = ServingEngine.serve_latest(
+            publish_dir, poll_secs=0.05, **ekw)
+        engines.append(control)
+
+        def candidate_engine(version, wrap=None):
+            fn = export_lib.load_serving(
+                os.path.join(publish_dir, version), buckets=tuple(buckets))
+            if wrap is not None:
+                fn = wrap(fn)
+            eng = ServingEngine(fn, **ekw)
+            engines.append(eng)
+            return eng
+
+        def warm(eng):
+            # Compile every bucket a drill request can hit, so measured
+            # latencies (the absolute-p99 gate's input) never include a
+            # first-flush compile.
+            for n in range(1, P["max_rows"] + 1):
+                eng.predict(np.zeros((n, FIELD_SIZE), np.int32),
+                            np.ones((n, FIELD_SIZE), np.float32),
+                            timeout=300)
+
+        # ---- closed serving loop with shadow serialization -------------
+        req_iter = iter(plan.requests)
+        labels = {}              # impression id -> ground-truth label
+        audit_samples = []       # (arm, label, prob, 0.0) in log order
+        failures = []
+        primary_nonfinite = [0]
+        logger = ImpressionLogger(imp_dir, shard_records=SHARD_RECORDS)
+        current_req = {}
+        window_ch = {"samples": None}
+        shadow_evt = threading.Event()
+
+        def on_shadow(rid, probs, latency_ms):
+            req = current_req[rid]
+            probs = np.asarray(probs)
+            logger.log_request(rid + SHADOW_IID_OFFSET, req.ids, req.vals,
+                               req.t_s, arm=ARM_CHALLENGER, preds=probs)
+            for k in range(int(req.ids.shape[0])):
+                p = float(probs[k])
+                window_ch["samples"].append(
+                    (ARM_CHALLENGER, float(req.labels[k]), p,
+                     float(latency_ms)))
+                audit_samples.append(
+                    (ARM_CHALLENGER, float(req.labels[k]), p, 0.0))
+            shadow_evt.set()
+
+        def serve_window(router, n_requests):
+            ctl, ch = [], []
+            window_ch["samples"] = ch
+            for _ in range(n_requests):
+                req = next(req_iter)
+                current_req[req.first_id] = req
+                expect_shadow = (
+                    router.mode == "shadow" and not router.killed
+                    and router.challenger is not None
+                    and router.assign(req.first_id) == ARM_CHALLENGER)
+                if expect_shadow:
+                    shadow_evt.clear()
+                try:
+                    fut = router.submit(req.ids, req.vals, req.first_id)
+                    probs = np.asarray(fut.result(timeout=60))
+                except Exception as e:  # noqa: BLE001 — the loss gate
+                    failures.append(f"req {req.first_id}: {e!r}")
+                    continue
+                if not np.all(np.isfinite(probs)):
+                    primary_nonfinite[0] += 1
+                # Serialize the shadow lane: this request's duplicate fully
+                # resolves (hook included) before the next submit, so
+                # challenger flushes never batch across requests and every
+                # prediction is bit-stable run to run.
+                if expect_shadow and not shadow_evt.wait(30):
+                    assert (router.shadow_errors
+                            + router.shadow_submit_rejected) > 0, \
+                        "shadow lane hung without a typed counter"
+                arm = fut.arm if fut.arm is not None else ARM_CONTROL
+                lat = float(fut.latency_ms or 0.0)
+                logger.log_request(req.first_id, req.ids, req.vals,
+                                   req.t_s, model_version=fut.model_version,
+                                   arm=arm, preds=probs)
+                for k in range(int(req.ids.shape[0])):
+                    y = float(req.labels[k])
+                    labels[req.first_id + k] = y
+                    p = float(probs[k])
+                    (ch if arm == ARM_CHALLENGER else ctl).append(
+                        (arm, y, p, lat))
+                    audit_samples.append((arm, y, p, 0.0))
+            return arm_health(ctl + ch)
+
+        gates = promote_lib.GateConfig(
+            min_samples=P["min_samples"], min_auc_delta=P["min_auc_delta"],
+            max_p99_ratio=P["max_p99_ratio"], max_p99_ms=P["max_p99_ms"],
+            max_nonfinite=0, max_calibration_err=P["max_calibration_err"],
+            max_candidate_age_s=P["max_candidate_age_s"],
+            windows_required=P["windows_required"])
+        active_router = [None]
+
+        def kill_switch(version, reason):
+            if active_router[0] is not None:
+                active_router[0].kill(f"{version}: {reason}")
+
+        controller = promote_lib.PromotionController(
+            publish_dir, gates=gates, on_rollback=kill_switch)
+        decisions = []
+
+        # ---- phase 1: shadow-validate the healthy challenger -----------
+        ch1 = candidate_engine("1")
+        warm(control)
+        warm(ch1)
+        r_shadow = ExperimentRouter(
+            control, ch1, mode="shadow", seed=seed,
+            challenger_permille=P["permille"],
+            shadow_slo_ms=P["shadow_slo_ms"], on_shadow_result=on_shadow)
+        active_router[0] = r_shadow
+        shadow_windows = []
+        for _ in range(P["windows_required"]):
+            h = serve_window(r_shadow, P["window_requests"])
+            passed, breaches, holds = promote_lib.evaluate_gates(
+                h.get(ARM_CHALLENGER, {}), h.get(ARM_CONTROL, {}), gates)
+            shadow_windows.append(
+                {"passed": passed, "breaches": breaches, "holds": holds,
+                 "challenger_n": h.get(ARM_CHALLENGER, {}).get("n", 0)})
+            assert passed, (
+                f"healthy challenger failed shadow validation: "
+                f"breaches={breaches} holds={holds} health={h}")
+        sh1 = r_shadow.summary()
+        assert sh1["shadow_completed"] > 0 and sh1["shadow_errors"] == 0 \
+            and sh1["shadow_nonfinite"] == 0, sh1
+        r_shadow.close()
+        say(f"shadow validation passed "
+            f"({sh1['shadow_completed']} duplicates observed)")
+
+        # ---- phase 2: canary + auto-promote -----------------------------
+        assert controller.offer("1", now_s=0.0)
+        r_canary = ExperimentRouter(
+            control, ch1, mode="canary", seed=seed,
+            challenger_permille=P["permille"])
+        active_router[0] = r_canary
+        for _ in range(P["windows_required"]):
+            h = serve_window(r_canary, P["window_requests"])
+            d = controller.observe(h.get(ARM_CHALLENGER, {}),
+                                   h.get(ARM_CONTROL, {}), now_s=1.0)
+            decisions.append(d)
+        assert decisions[-1].action == "promote", decisions
+        deadline = time.monotonic() + 20
+        while os.path.basename(control.watcher.current_path or "") != "1":
+            assert time.monotonic() < deadline, \
+                "control engine never hot-swapped to the promoted v1"
+            time.sleep(0.02)
+        serve_window(r_canary, 4)   # zero-loss across the promotion swap
+        r_canary.close()
+        say("healthy challenger canaried and auto-promoted; LATEST -> 1")
+
+        # ---- phase 3: poisoned challengers ------------------------------
+        scen_reports = []
+        for kind, version, reason in scenarios:
+            if kind == "challenger_stale":
+                ds = []
+                for _ in range(2):
+                    assert controller.offer(version, now_s=0.0)
+                    ds.append(controller.observe(
+                        {}, {}, now_s=P["stale_age_s"]))
+            else:
+                if kind == "challenger_nan":
+                    eng = candidate_engine(version)
+                else:
+                    def slowed(fn):
+                        def wrapped(ids, vals):
+                            time.sleep(slow_delay_s)
+                            return fn(ids, vals)
+                        return wrapped
+                    eng = candidate_engine("1", wrap=slowed)
+                warm(eng)
+                r = ExperimentRouter(
+                    control, eng, mode="shadow", seed=seed,
+                    challenger_permille=P["permille"],
+                    shadow_slo_ms=P["shadow_slo_ms"],
+                    on_shadow_result=on_shadow)
+                active_router[0] = r
+                ds = []
+                for _ in range(2):
+                    assert controller.offer(version, now_s=0.0)
+                    r.revive()   # each offer earns a fresh shadow shot
+                    h = serve_window(r, P["window_requests"])
+                    ds.append(controller.observe(
+                        h.get(ARM_CHALLENGER, {}),
+                        h.get(ARM_CONTROL, {}), now_s=1.0))
+                assert r.killed and version in (r.kill_reason or ""), (
+                    f"kill-switch never pulled for {kind}: "
+                    f"{r.kill_reason!r}")
+                if kind == "challenger_slow":
+                    assert r.shadow_slo_misses > 0, r.summary()
+                r.close()
+            assert ds[0].action == "rollback" and reason in ds[0].reasons, \
+                (kind, ds)
+            assert ds[1].action == "quarantine" \
+                and reason in ds[1].reasons, (kind, ds)
+            assert not controller.offer(version, now_s=0.0), (
+                f"quarantined {version} was re-admitted")
+            decisions.extend(ds)
+            scen_reports.append({
+                "kind": kind, "version": version,
+                "expected_reason": reason,
+                "decisions": [[d.action, d.version, list(d.reasons)]
+                              for d in ds]})
+            say(f"{kind}: v{version} rolled back ({reason}) "
+                f"and quarantined")
+        active_router[0] = None
+        logger.close()
+
+        # ---- gates -------------------------------------------------------
+        stats = control.stats.summary()
+        assert not failures, failures[:5]
+        assert primary_nonfinite[0] == 0, (
+            f"{primary_nonfinite[0]} primary responses went non-finite — "
+            f"challenger poison leaked into the primary lane")
+        assert stats["serving_failed"] == 0 \
+            and stats["serving_overloads"] == 0, stats
+        latest = export_lib.read_latest(publish_dir)
+        assert latest is not None \
+            and os.path.basename(latest) == "1", latest
+
+        history = [(e["version"], e["actor"], e["reason"])
+                   for e in export_lib.pointer_history(publish_dir)]
+        actors = [a for _, a, _ in history]
+        # One rollback LINE per scenario: the second rollback of the same
+        # candidate carries the identical (version, actor, reason) and the
+        # sidecar's tail-dedupe (the crash-heal rule) absorbs it — the
+        # controller's counters carry the multiplicity.
+        assert actors[0] == "publish" and actors.count("promote") == 1 \
+            and actors.count("quarantine") == len(scenarios) \
+            and actors.count("rollback") == len(scenarios), history
+        pstats = controller.stats()
+        assert pstats["rollbacks"] == 2 * len(scenarios) \
+            and pstats["quarantines"] == len(scenarios) \
+            and pstats["promotions"] == 1, pstats
+
+        # ---- per-arm health: online accumulation vs a pure offline
+        # recomputation from the impression log (bit-exact) ---------------
+        offline_samples = []
+        for shard in logger.shards:
+            for rec in tfrecord.iter_records(shard):
+                s_arm, s_pred = impressions_lib.read_experiment(rec)
+                if s_arm is None or s_pred is None:
+                    continue
+                iid, _, _, _ = impressions_lib.decode_impression(rec)
+                offline_samples.append(
+                    (s_arm, labels[iid % SHADOW_IID_OFFSET], s_pred, 0.0))
+        online_health = arm_health(audit_samples)
+        offline_health = arm_health(offline_samples)
+        assert online_health == offline_health, (
+            f"per-arm health diverged between online accumulation and the "
+            f"impression-log recomputation:\n  online  {online_health}\n"
+            f"  offline {offline_health}")
+        say("per-arm health: online == offline recomputation (bit-exact "
+            f"over {len(audit_samples)} samples)")
+
+        fingerprint = hashlib.sha256(json.dumps(
+            {"schedule": schedule.to_json(),
+             "plan": hashlib.sha256(
+                 repr(plan.fingerprint_data()).encode()).hexdigest(),
+             "params": {k: P[k] for k in sorted(P)},
+             "history": history,
+             "decisions": [[d.action, d.version, list(d.reasons)]
+                           for d in decisions],
+             "arm_health": {
+                 str(a): {k: v for k, v in h.items()
+                          if k != "p99_latency_ms"}
+                 for a, h in online_health.items()},
+             "outcomes": {"stable_version": "1",
+                          "quarantined": sorted(controller.quarantined),
+                          "primary_failed": len(failures),
+                          "primary_nonfinite": primary_nonfinite[0],
+                          "nan_batches_poisoned": nan_poisoned}},
+            sort_keys=True).encode()).hexdigest()[:16]
+
+        import jax
+        return {
+            "drill": "experiment",
+            "ok": True,
+            "seed": seed,
+            "params": {k: P[k] for k in sorted(P)},
+            "chaos": {"fingerprint": schedule.fingerprint(),
+                      "events": json.loads(schedule.to_json())["events"]},
+            "shadow_validation": shadow_windows,
+            "shadow_summary": {k: sh1[k] for k in (
+                "shadow_submitted", "shadow_completed", "shadow_errors",
+                "shadow_nonfinite", "shadow_slo_misses")},
+            "scenarios": scen_reports,
+            "promotion": controller.stats(),
+            "pointer_history": [
+                {"version": v, "actor": a, "reason": r}
+                for v, a, r in history],
+            "primary": {"requests": stats["serving_requests"],
+                        "failed": len(failures),
+                        "overloads": stats["serving_overloads"],
+                        "nonfinite": primary_nonfinite[0],
+                        "hot_swaps": control.watcher.swap_count},
+            "arm_health_online": {str(a): h
+                                  for a, h in online_health.items()},
+            "arm_health_offline_match": True,
+            "stable_version": "1",
+            "nan_batches_poisoned": nan_poisoned,
+            "audit_fingerprint": fingerprint,
+            "device_kind": jax.devices()[0].platform,
+            "load_kind": "synthetic-closed-loop-serialized",
+            "elapsed_s": round(time.time() - t_start, 1),
+        }
+    finally:
+        os.environ.pop("DEEPFM_TPU_SKIP_TF_EXPORT", None)
+        faults_lib.take_nan_plan()       # never leak an armed plan
+        for eng in engines:
+            try:
+                eng.close(timeout=5)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
 
 
 def _next_report_path():

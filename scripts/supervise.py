@@ -49,18 +49,33 @@ def run_supervised(cmd, *, max_restarts=5, backoff_secs=1.0,
     spawn = spawn if spawn is not None else (lambda c: subprocess.call(c))
     restarts = 0
     total_restarts = 0
+    # Exit-code histogram over every nonzero child exit, so a drill audit
+    # can assert WHY relaunches happened (42 preemptions vs 43 watchdog
+    # aborts vs ordinary crashes), not just how many.
+    exits = {preempt_lib.EXIT_PREEMPTED: 0, preempt_lib.EXIT_WATCHDOG: 0,
+             "other": 0}
+
+    def summarize():
+        log(f"[supervise] exit histogram: "
+            f"preempted(42)={exits[preempt_lib.EXIT_PREEMPTED]} "
+            f"watchdog(43)={exits[preempt_lib.EXIT_WATCHDOG]} "
+            f"other={exits['other']}; total restarts {total_restarts}")
     while True:
         started = clock()
         rc = spawn(cmd)
         ran_secs = clock() - started
+        if rc != 0:
+            exits[rc if rc in exits else "other"] += 1
         if rc == 0:
             if total_restarts:
                 log(f"[supervise] run completed after {total_restarts} "
                     f"restart(s)")
+            summarize()
             return 0
         if rc not in preempt_lib.RESTARTABLE_EXIT_CODES:
             log(f"[supervise] child failed with non-restartable exit code "
                 f"{rc}; giving up")
+            summarize()
             return rc
         if healthy_secs > 0 and ran_secs >= healthy_secs and restarts:
             log(f"[supervise] child ran healthy for {ran_secs:.0f}s "
@@ -69,11 +84,13 @@ def run_supervised(cmd, *, max_restarts=5, backoff_secs=1.0,
         if restarts >= max_restarts:
             log(f"[supervise] restart budget exhausted "
                 f"({restarts}/{max_restarts}); last exit code {rc}")
+            summarize()
             return rc
         if max_total_restarts > 0 and total_restarts >= max_total_restarts:
             log(f"[supervise] total restart cap reached "
                 f"({total_restarts}/{max_total_restarts}); last exit "
                 f"code {rc}")
+            summarize()
             return rc
         delay = backoff_secs * (2 ** restarts)
         restarts += 1

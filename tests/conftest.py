@@ -93,6 +93,12 @@ def pytest_configure(config):
         "all-to-all row exchange, sharded lazy-Adam, resharding "
         "checkpoints) that compare mesh vs single-device trajectories; "
         "gated on the mesh_bitexact probe")
+    config.addinivalue_line(
+        "markers",
+        "experiment: gated-deployment plane tests (hash-split A/B/shadow/"
+        "canary routing, shadow-lane isolation, promotion controller, "
+        "pointer-history audit sidecar, experimentation drill); the "
+        "full-parameter drill is also slow")
 
 
 # ---------------------------------------------------------------------------

@@ -148,6 +148,40 @@ class TestSupervisor:
             max_restarts=2, backoff_secs=0.0, healthy_secs=50.0)
         assert rc == 42 and len(left) == 8
 
+    def test_exit_histogram_types_every_relaunch_reason(self):
+        """The final summary line types WHY relaunches happened (42
+        preemptions vs 43 watchdog aborts vs ordinary crashes), not just
+        how many — and it is emitted on every exit path, including the
+        non-restartable one."""
+        logs = []
+        seq = [42, 43, 1]
+        rc = supervise.run_supervised(
+            ["train"], spawn=lambda cmd: seq.pop(0),
+            sleep=lambda s: None, log=logs.append)
+        assert rc == 1 and seq == []
+        hist = [m for m in logs if "exit histogram" in m]
+        assert hist == ["[supervise] exit histogram: preempted(42)=1 "
+                        "watchdog(43)=1 other=1; total restarts 2"]
+
+    def test_exit_histogram_on_clean_and_exhausted_paths(self):
+        logs = []
+        rc = supervise.run_supervised(
+            ["train"], spawn=lambda cmd: 0, sleep=lambda s: None,
+            log=logs.append)
+        assert rc == 0
+        assert [m for m in logs if "exit histogram" in m] == [
+            "[supervise] exit histogram: preempted(42)=0 watchdog(43)=0 "
+            "other=0; total restarts 0"]
+        logs = []
+        seq = [42] * 3
+        rc = supervise.run_supervised(
+            ["train"], spawn=lambda cmd: seq.pop(0), max_restarts=2,
+            sleep=lambda s: None, log=logs.append)
+        assert rc == 42
+        assert [m for m in logs if "exit histogram" in m] == [
+            "[supervise] exit histogram: preempted(42)=3 watchdog(43)=0 "
+            "other=0; total restarts 2"]
+
     def test_total_cap_breaks_healthy_crash_loop(self):
         # The pathological case --healthy_secs alone cannot bound: a child
         # that keeps limping past the healthy threshold and dying again

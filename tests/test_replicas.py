@@ -439,6 +439,33 @@ class TestAggregateStats:
         assert agg["serving_qps"] == 2.5
         assert agg["batch_occupancy_pct"] == pytest.approx(50.0)
 
+    def test_empty_window_is_none_and_zero_qps(self):
+        """A replica that has served NOTHING yet (startup, or a canary arm
+        drained by the kill-switch before its first completion) must
+        summarize as 0 QPS with None percentiles — never raise, never
+        fabricate a number (regression: the percentile helper used to
+        index into an empty reservoir)."""
+        s = ServingStats()
+        one = s.summary()
+        assert one["serving_requests"] == 0
+        assert one["serving_qps"] == 0.0
+        assert one["serving_p50_ms"] is None
+        assert one["serving_p99_ms"] is None
+        agg = aggregate_summary([ServingStats(), ServingStats()])
+        assert agg["replicas"] == 2
+        assert agg["serving_requests"] == 0
+        assert agg["serving_qps"] == 0.0
+        assert agg["serving_p50_ms"] is None
+        assert agg["serving_p99_ms"] is None
+        assert agg["batch_occupancy_pct"] is None
+
+    def test_empty_fleet_aggregate(self):
+        agg = aggregate_summary([])
+        assert agg["replicas"] == 0
+        assert agg["serving_requests"] == 0
+        assert agg["serving_qps"] == 0.0
+        assert agg["serving_p99_ms"] is None
+
     def test_worst_replica_blackout_and_per_replica_list(self):
         clock = [0.0]
         a, b = (ServingStats(clock=lambda: clock[0]) for _ in range(2))
