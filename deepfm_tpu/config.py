@@ -235,6 +235,31 @@ class Config:
     # bypass into every forming batch (never stranded behind a max-batch
     # fill of large requests). 0 disables the lane.
     serve_small_rows: int = 0
+    # ---- serving fast path (serve/cache.py; README "Serving fast path",
+    # TUNING §2.20) ----
+    # Version-keyed LRU result cache, capacity in ROWS (same unit as
+    # serve_queue_rows): a request whose (ids, vals) bytes match a response
+    # already flushed under the CURRENT model version resolves immediately,
+    # bit-identical to the cached flush. Hot swaps invalidate for free
+    # (the key carries the artifact version). 0 disables the cache.
+    serve_cache_rows: int = 0
+    # Cache entry TTL in seconds (lazy expiry at lookup). 0 = no TTL; LRU
+    # eviction alone bounds staleness within a model version.
+    serve_cache_ttl_s: float = 0.0
+    # In-flight request coalescing: concurrent byte-identical requests
+    # attach to one leader future; a single device execution fans out to
+    # every joined caller. Off by default (exact pre-existing behavior).
+    serve_coalesce: bool = False
+    # Per-user tower-embedding cache in the cascade (entries = users): a
+    # head user's repeat request skips the user-tower forward pass. Keyed
+    # by (artifact version, history bytes) — swap-safe. 0 disables.
+    serve_cache_user_rows: int = 0
+    # Fused cascade program: collapse user-embed -> index top-k ->
+    # candidate-substitute -> rank into ONE jitted per-bucket batch
+    # program (device-side top-k, vectorized ITEM_SLOT substitution and
+    # history fitting). Brute index only; falls back to the staged path
+    # (counted) when the artifact can't fuse. Off by default.
+    serve_fused_cascade: bool = False
     # ---- overload plane (serve/admission.py; README "Overload &
     # degradation", TUNING §2.18) ----
     # Per-request latency SLO: the admission gate sheds low-value classes
@@ -548,6 +573,13 @@ class Config:
                 "serve_small_rows must be in 0..serve_max_batch "
                 f"(got {self.serve_small_rows} vs "
                 f"serve_max_batch={self.serve_max_batch})")
+        if self.serve_cache_rows < 0:
+            raise ValueError("serve_cache_rows must be >= 0 (0 disables)")
+        if self.serve_cache_ttl_s < 0:
+            raise ValueError("serve_cache_ttl_s must be >= 0 (0 = no TTL)")
+        if self.serve_cache_user_rows < 0:
+            raise ValueError(
+                "serve_cache_user_rows must be >= 0 (0 disables)")
         if self.serve_slo_ms < 0:
             raise ValueError("serve_slo_ms must be >= 0 (0 disables)")
         if self.serve_shed_watermark < 0:

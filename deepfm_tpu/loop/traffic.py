@@ -194,6 +194,14 @@ class FloodTrafficPlan:
     Construction order is the determinism contract: building the same
     plans in the same order from a fresh same-seed population reproduces
     identical traffic (``fingerprint_data``).
+
+    ``repeat_p`` > 0 makes each returning user REPLAY their previous
+    request byte-identically with that probability (same ids/vals/history
+    arrays, no history mutation) — the workload shape the serving result
+    cache and in-flight coalescing monetize. Fresh randoms never produce
+    byte-identical requests, so without this knob a flood cannot exercise
+    the fast path at all. ``repeat_p=0`` (the default) draws NOTHING extra
+    from the rng stream: existing plans reproduce bit-identically.
     """
 
     #: seeded value-class mix (lowest value first; must sum to 1)
@@ -202,19 +210,26 @@ class FloodTrafficPlan:
 
     def __init__(self, seed: int, *, offered_qps: float, duration_s: float,
                  population: ZipfUserPopulation,
-                 field_size: int, feature_size: int, max_rows: int = 1):
+                 field_size: int, feature_size: int, max_rows: int = 1,
+                 repeat_p: float = 0.0):
         if offered_qps <= 0 or duration_s <= 0:
             raise ValueError(
                 f"need positive offered_qps/duration_s, got "
                 f"{offered_qps}/{duration_s}")
+        if not 0.0 <= repeat_p < 1.0:
+            raise ValueError(
+                f"repeat_p must be in [0, 1), got {repeat_p}")
         self.seed = int(seed)
         self.offered_qps = float(offered_qps)
         self.duration_s = float(duration_s)
+        self.repeat_p = float(repeat_p)
         self.population = population
         rng = np.random.default_rng(self.seed)
         classes = [c for c, _ in self.VALUE_MIX]
         probs = np.asarray([p for _, p in self.VALUE_MIX])
         requests: List[FloodRequest] = []
+        last: dict = {}   # user -> (ids, vals, hist_ids, hist_mask)
+        repeats = 0
         t, next_id = 0.0, 0
         while True:
             t += float(rng.exponential(1.0 / self.offered_qps))
@@ -222,6 +237,19 @@ class FloodTrafficPlan:
                 break
             user = int(population.sample_users(rng, 1)[0])
             value = classes[int(rng.choice(len(classes), p=probs))]
+            prev = last.get(user) if self.repeat_p > 0 else None
+            if prev is not None and float(rng.random()) < self.repeat_p:
+                # Byte-identical replay of this user's previous request:
+                # same arrays, same history, NO click (the replay is the
+                # same impression, not a new one).
+                ids, vals, hist_ids, hist_mask = prev
+                requests.append(FloodRequest(
+                    t_s=round(t, 6), user_id=user, value=value,
+                    first_id=next_id, ids=ids, vals=vals,
+                    hist_ids=hist_ids, hist_mask=hist_mask))
+                next_id += int(ids.shape[0])
+                repeats += 1
+                continue
             item = int(population.sample_items(rng, 1)[0]) \
                 % max(1, feature_size)
             n = int(rng.integers(1, max_rows + 1)) if max_rows > 1 else 1
@@ -236,8 +264,11 @@ class FloodTrafficPlan:
                 hist_ids=hist_ids, hist_mask=hist_mask))
             population.click(user, item)
             next_id += n
+            if self.repeat_p > 0:
+                last[user] = (ids, vals, hist_ids, hist_mask)
         self.requests: Tuple[FloodRequest, ...] = tuple(requests)
         self.total_rows = next_id
+        self.repeat_requests = repeats
 
     def fingerprint_data(self) -> Tuple:
         """Deterministic digestable view for audit fingerprints."""

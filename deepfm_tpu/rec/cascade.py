@@ -24,16 +24,21 @@ from __future__ import annotations
 
 import json
 import os
+import threading
+import time
 from typing import Callable, Dict, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..config import Config
 from ..data import fileio
 from ..models.twin_tower import TwinTower
+from ..obs import trace as trace_lib
 from ..serve.admission import (DEGRADE_RUNGS, VALUE_DEFAULT,
                                AdmissionController, DegradationLadder)
+from ..serve.cache import ResultCache, request_fingerprint
 from ..serve.engine import ServingEngine
 from ..serve.stats import ServingStats
 from ..utils import export as export_lib
@@ -119,6 +124,12 @@ class CascadeModel:
         self.tower_model, self.tower_params = load_towers(path)
         self.index, self.index_meta = CandidateIndex.load(path)
         self._user_fn = jax.jit(self.tower_model.user_embed)
+        # Fused cascade program cache: one jitted program per
+        # (batch_bucket, seq_len, retrieve_k, k) — same bounded-compile
+        # discipline as BucketedPredict. Living on the MODEL means a hot
+        # swap drops every stale program with the old version for free.
+        self._fused_cache: Dict[Tuple[int, int, int, int], Callable] = {}
+        self.fused_failed = False   # set on first structural fusion error
 
     # engine-facing predict: delegate, keep prewarm metadata visible
     def __call__(self, feat_ids, feat_vals):
@@ -137,6 +148,82 @@ class CascadeModel:
         return np.asarray(self._user_fn(
             self.tower_params, hist_ids.astype(np.int32),
             hist_mask.astype(np.float32)))
+
+    # ------------------------------------------------------ fused program
+    @property
+    def supports_fused(self) -> bool:
+        """The fused device program needs a TRACEABLE ranker (the artifact
+        loader attaches ``raw_call`` when the StableHLO/params path allows
+        it) and a fusable index — ``brute`` is one ``top_k`` over a matmul;
+        the ANN's host-side partition scan cannot live inside jit."""
+        return (getattr(self.rank_fn, "raw_call", None) is not None
+                and self.index.kind == "brute"
+                and not self.fused_failed)
+
+    def fused_program(self, batch: int, seq_len: int, retrieve_k: int,
+                      k: int) -> Callable:
+        """ONE jitted program for the whole per-request cascade at this
+        shape: user tower -> device top-k retrieval -> candidate
+        substitution into ``ITEM_SLOT`` -> history fitting -> ranker ->
+        device top-k of the ranked probabilities. Everything between the
+        request arrays and the final (ids, probs) stays on device — no
+        host round-trip between stages. Compiled once per shape key and
+        cached on this model version.
+
+        Stage-for-stage it computes exactly what the staged path computes:
+        the same ``q @ V.T`` + ``lax.top_k`` retrieval (same tie-break:
+        lowest index first, matching the staged ``argsort(kind="stable")``),
+        the same zero-padded history fit, and the ranker through the same
+        exported program — pinned bit-equal in ``tests/test_cascade.py``.
+        """
+        key = (int(batch), int(seq_len), int(retrieve_k), int(k))
+        fn = self._fused_cache.get(key)
+        if fn is not None:
+            return fn
+        raw = self.rank_fn.raw_call
+        mat = jnp.asarray(self.index.vectors)                    # [V, D]
+        item_ids = jnp.asarray(self.index.ids.astype(np.int32))  # [V]
+        field = int(self.field_size)
+        hist_len = int(self.hist_len)
+        tower_params = self.tower_params
+        user_fn = self.tower_model.user_embed
+        b, n, kk = key[0], int(retrieve_k), int(k)
+        fit = min(int(seq_len), hist_len)
+
+        def prog(hist_ids, hist_mask, feat_ids, feat_vals):
+            users = user_fn(tower_params, hist_ids, hist_mask)   # [B, D]
+            _, rows = jax.lax.top_k(users @ mat.T, n)            # [B, N]
+            cands = item_ids[rows]                               # [B, N]
+            ids = jnp.broadcast_to(feat_ids[:, None, :], (b, n, field))
+            ids = ids.at[:, :, ITEM_SLOT].set(cands)
+            vals = jnp.broadcast_to(feat_vals[:, None, :], (b, n, field))
+            if hist_len:
+                # static _fit_history: keep the most recent tail, zero-pad
+                h_ids = jnp.zeros((b, hist_len), jnp.int32)
+                h_ids = h_ids.at[:, :fit].set(hist_ids[:, seq_len - fit:])
+                h_mask = jnp.zeros((b, hist_len), jnp.float32)
+                h_mask = h_mask.at[:, :fit].set(
+                    hist_mask[:, seq_len - fit:])
+                ids = jnp.concatenate(
+                    [ids, jnp.broadcast_to(h_ids[:, None, :],
+                                           (b, n, hist_len))], axis=2)
+                vals = jnp.concatenate(
+                    [vals, jnp.broadcast_to(h_mask[:, None, :],
+                                            (b, n, hist_len))], axis=2)
+            probs = raw(ids.reshape(b * n, -1).astype(jnp.int32),
+                        vals.reshape(b * n, -1).astype(jnp.float32))
+            if isinstance(probs, dict):
+                raise TypeError(
+                    "fused cascade needs a single-output ranker; "
+                    "multitask artifacts use the staged path")
+            probs = jnp.reshape(probs, (b, n))
+            top_p, top_i = jax.lax.top_k(probs, kk)
+            top_ids = jnp.take_along_axis(cands, top_i, axis=1)
+            return top_ids, top_p
+
+        fn = jax.jit(prog)
+        self._fused_cache[key] = fn
+        return fn
 
 
 class CascadeEngine:
@@ -176,6 +263,10 @@ class CascadeEngine:
                  queue_rows: int = 0,
                  slo_ms: float = 0.0, shed_watermark: int = 0,
                  degrade_retrieve_k: int = 0,
+                 fused: bool = False,
+                 user_cache_rows: int = 0,
+                 cache_rows: int = 0, cache_ttl_s: float = 0.0,
+                 coalesce: bool = False,
                  watcher_kw: Optional[dict] = None,
                  engine_kw: Optional[dict] = None):
         if retrieve_k < 1:
@@ -184,6 +275,9 @@ class CascadeEngine:
             raise ValueError(
                 f"degrade_retrieve_k must be in 0..retrieve_k="
                 f"{retrieve_k}, got {degrade_retrieve_k}")
+        if user_cache_rows < 0:
+            raise ValueError(
+                f"user_cache_rows must be >= 0, got {user_cache_rows}")
         self.retrieve_k = int(retrieve_k)
         self.degrade_retrieve_k = int(degrade_retrieve_k)
         resolved = tuple(buckets) if buckets is not None \
@@ -201,9 +295,27 @@ class CascadeEngine:
                 and "admission" not in ekw and "admission_kw" not in ekw:
             ekw["admission_kw"] = {"slo_ms": slo_ms,
                                    "shed_watermark": shed_watermark}
+        # Fast-path levers forward to the inner ranking engine: the result
+        # cache there caches whole ranking batches under the same
+        # (version, fingerprint) law as standalone serving.
+        ekw.setdefault("cache_rows", cache_rows)
+        ekw.setdefault("cache_ttl_s", cache_ttl_s)
+        ekw.setdefault("coalesce", coalesce)
         self._engine = ServingEngine(
             self._watcher, max_batch=max_batch, max_delay_ms=max_delay_ms,
             buckets=resolved, queue_rows=queue_rows, stats=stats, **ekw)
+        # Fused device program (opt-in; falls back per-model on any
+        # structural fusion failure) + the per-user tower-embedding cache.
+        self.fused = bool(fused)
+        self._fused_buckets = resolved
+        self.fused_calls = 0
+        self._user_cache = ResultCache(user_cache_rows) \
+            if user_cache_rows > 0 else None
+        self.user_cache_hits = 0
+        self.user_cache_misses = 0
+        self._fast_lock = threading.Lock()
+        stats.set_policy(serve_fused_cascade=self.fused,
+                         serve_cache_user_rows=int(user_cache_rows))
         self._ladder: Optional[DegradationLadder] = None
         if self.degrade_retrieve_k > 0:
             self._ladder = DegradationLadder(stats=stats)
@@ -258,13 +370,38 @@ class CascadeEngine:
         return self._ladder.update(self._pressure())
 
     # ------------------------------------------------------------- serving
+    def _user_embed(self, model: CascadeModel, hist_ids: np.ndarray,
+                    hist_mask: np.ndarray) -> np.ndarray:
+        """User-tower embedding with the per-user cache in front: keyed
+        ``(artifact path, fingerprint(history))`` so a hot swap — a new
+        path — invalidates every cached embedding for free, exactly like
+        the result cache's version key. Hits return bit-identical copies
+        of the tower's output; a Zipf head user pays the tower once per
+        artifact version instead of once per request."""
+        if self._user_cache is None:
+            return model.user_embed(hist_ids, hist_mask)
+        fp = request_fingerprint(hist_ids, hist_mask)
+        hit = self._user_cache.get(model.path, fp)
+        if hit is not None:
+            with self._fast_lock:
+                self.user_cache_hits += 1
+            trace_lib.instant("serve.cache", event="user_hit",
+                              rows=int(hist_ids.shape[0]))
+            return hit
+        users = model.user_embed(hist_ids, hist_mask)
+        self._user_cache.put(model.path, fp, users,
+                             int(hist_ids.shape[0]))
+        with self._fast_lock:
+            self.user_cache_misses += 1
+        return users
+
     def retrieve(self, hist_ids: np.ndarray, hist_mask: np.ndarray,
                  k: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
         """Retrieval stage only: (item_ids [B, k], scores [B, k])."""
         model = self.current()
         hist_ids = np.atleast_2d(np.asarray(hist_ids, np.int32))
         hist_mask = np.atleast_2d(np.asarray(hist_mask, np.float32))
-        users = model.user_embed(hist_ids, hist_mask)
+        users = self._user_embed(model, hist_ids, hist_mask)
         return model.index.search(users, k or self.retrieve_k)
 
     def recommend(self, hist_ids: np.ndarray, hist_mask: np.ndarray,
@@ -296,9 +433,18 @@ class CascadeEngine:
                 f"expected {model.field_size} context fields, "
                 f"got {feat_ids.shape[0]}")
         rung = self.ladder_rung()
+        if rung == 0 and self.fused and model.supports_fused:
+            try:
+                ids_k, probs_k = self._recommend_fused(
+                    model, hist_ids, hist_mask, feat_ids[None],
+                    feat_vals[None], k)
+                return ids_k[0], probs_k[0]
+            except Exception:  # noqa: BLE001 — structural; staged fallback
+                model.fused_failed = True
+                trace_lib.instant("serve.cascade_fused", event="fallback")
         retrieve_k = self.retrieve_k if rung == 0 \
             else self.degrade_retrieve_k
-        users = model.user_embed(hist_ids, hist_mask)
+        users = self._user_embed(model, hist_ids, hist_mask)
         cand_ids, cand_scores = model.index.search(users, retrieve_k)
         cand_ids = cand_ids[0]                              # [N]
         n = cand_ids.shape[0]
@@ -326,6 +472,85 @@ class CascadeEngine:
         top = np.argsort(-probs, kind="stable")[:k]
         return cand_ids[top], probs[top]
 
+    # ----------------------------------------------------- fused fast path
+    def _recommend_fused(self, model: CascadeModel, hist_ids: np.ndarray,
+                         hist_mask: np.ndarray, feat_ids: np.ndarray,
+                         feat_vals: np.ndarray, k: int
+                         ) -> Tuple[np.ndarray, np.ndarray]:
+        """Run [B] users through the single fused device program. The
+        batch pads up to the engine's pow2 bucket ladder (pad users are
+        all-zeros and sliced away), so at most ``len(buckets)`` programs
+        compile per (seq_len, retrieve_k, k) — the same bounded-compile
+        discipline as the staged ranker. Completions are recorded into
+        the SAME stats reservoirs as staged requests."""
+        t0 = time.monotonic()
+        b = int(hist_ids.shape[0])
+        bucket = export_lib.next_bucket(b, self._fused_buckets)
+        if bucket != b:
+            hist_ids = np.concatenate(
+                [hist_ids, np.zeros((bucket - b,) + hist_ids.shape[1:],
+                                    np.int32)])
+            hist_mask = np.concatenate(
+                [hist_mask, np.zeros((bucket - b,) + hist_mask.shape[1:],
+                                     np.float32)])
+            feat_ids = np.concatenate(
+                [feat_ids, np.zeros((bucket - b,) + feat_ids.shape[1:],
+                                    np.int32)])
+            feat_vals = np.concatenate(
+                [feat_vals, np.zeros((bucket - b,) + feat_vals.shape[1:],
+                                     np.float32)])
+        n = min(self.retrieve_k, model.index.num_items)
+        kk = min(int(k), n)
+        fn = model.fused_program(bucket, int(hist_ids.shape[1]), n, kk)
+        top_ids, top_p = fn(hist_ids.astype(np.int32),
+                            hist_mask.astype(np.float32),
+                            feat_ids.astype(np.int32),
+                            feat_vals.astype(np.float32))
+        top_ids = np.asarray(top_ids)[:b]
+        top_p = np.asarray(top_p)[:b]
+        lat_ms = 1000.0 * (time.monotonic() - t0)
+        with self._fast_lock:
+            self.fused_calls += 1
+        for _ in range(b):
+            self.stats.record_request_done(lat_ms)
+        # int64 ids on the way out, matching the staged index.search dtype
+        return top_ids.astype(np.int64), top_p
+
+    def recommend_batch(self, hist_ids: np.ndarray, hist_mask: np.ndarray,
+                        feat_ids: np.ndarray, feat_vals: np.ndarray, *,
+                        k: int = 10, timeout: Optional[float] = 30.0,
+                        value: str = VALUE_DEFAULT
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+        """[B] users end-to-end at once: (item_ids [B, k], probs [B, k]).
+
+        With the fused program armed (``fused=True`` and a fusable
+        artifact, full-cascade rung) the whole batch is ONE device
+        dispatch; otherwise each row runs the staged path. Output is
+        row-for-row what per-row :meth:`recommend` returns — same items,
+        probabilities to float ULP (batching changes XLA's row
+        vectorization; the B=1 fused path is bit-equal to staged,
+        pinned in ``tests/test_cascade.py``)."""
+        hist_ids = np.atleast_2d(np.asarray(hist_ids, np.int32))
+        hist_mask = np.atleast_2d(np.asarray(hist_mask, np.float32))
+        feat_ids = np.atleast_2d(np.asarray(feat_ids, np.int32))
+        feat_vals = np.atleast_2d(np.asarray(feat_vals, np.float32))
+        model = self.current()
+        if self.fused and model.supports_fused and self.ladder_rung() == 0:
+            try:
+                return self._recommend_fused(model, hist_ids, hist_mask,
+                                             feat_ids, feat_vals, k)
+            except Exception:  # noqa: BLE001 — structural; staged fallback
+                model.fused_failed = True
+                trace_lib.instant("serve.cascade_fused", event="fallback")
+        out_ids, out_ps = [], []
+        for i in range(hist_ids.shape[0]):
+            ids_i, p_i = self.recommend(
+                hist_ids[i], hist_mask[i], feat_ids[i], feat_vals[i],
+                k=k, timeout=timeout, value=value)
+            out_ids.append(ids_i)
+            out_ps.append(p_i)
+        return np.stack(out_ids), np.stack(out_ps)
+
     # ----------------------------------------------------------- lifecycle
     def close(self, timeout: Optional[float] = None) -> None:
         self._engine.close(timeout=timeout)
@@ -341,8 +566,19 @@ class CascadeEngine:
 def _fit_history(hist_ids: np.ndarray, hist_mask: np.ndarray,
                  hist_len: int) -> Tuple[np.ndarray, np.ndarray]:
     """Pad/truncate a request's history to the artifact's trained length
-    (keep the most recent tail on truncation)."""
+    (keep the most recent tail on truncation).
+
+    Short-circuits: a history-free artifact (``hist_len`` 0 — previously
+    this built and sliced zero-length scratch arrays per candidate batch)
+    returns empty arrays immediately, and an already-fitting history is
+    passed through without a re-fit copy."""
+    hist_len = int(hist_len)
+    if hist_len <= 0:
+        return np.zeros((0,), np.int32), np.zeros((0,), np.float32)
     ln = hist_ids.shape[0]
+    if ln == hist_len:
+        return (np.asarray(hist_ids, np.int32),
+                np.asarray(hist_mask, np.float32))
     out_ids = np.zeros((hist_len,), np.int32)
     out_mask = np.zeros((hist_len,), np.float32)
     n = min(ln, hist_len)

@@ -7,6 +7,9 @@ Layering (heaviest import last — clients can use :mod:`.frontend` and
   * :mod:`.admission` — SLO-aware admission gate (value classes, hysteresis
     shed ladder, typed ``AdmissionShed``) and the cascade's degradation
     ladder; jax-free.
+  * :mod:`.cache` — serving fast path: version-keyed LRU result cache and
+    the request fingerprint that defines "the same request" for caching and
+    in-flight coalescing; jax-free.
   * :mod:`.engine` — bounded queue, dynamic batcher, bucketed predict,
     response demux, hot swap via ``utils.export.LatestWatcher`` (the jax
     import happens lazily at engine construction).
@@ -23,6 +26,7 @@ Layering (heaviest import last — clients can use :mod:`.frontend` and
 
 from .admission import (VALUE_CLASSES, VALUE_DEFAULT, AdmissionController,
                         AdmissionShed, DegradationLadder, HysteresisLadder)
+from .cache import ResultCache, request_fingerprint
 from .engine import ServeFuture, ServeTimeout, ServerOverloaded, ServingEngine
 from .experiment import (ARM_CHALLENGER, ARM_CONTROL, ExperimentRouter,
                          assign_arm)
@@ -43,6 +47,7 @@ __all__ = [
     "HedgedFuture",
     "HysteresisLadder",
     "ReplicatedEngine",
+    "ResultCache",
     "ServeFuture",
     "ServeTimeout",
     "ServerOverloaded",
@@ -54,4 +59,5 @@ __all__ = [
     "aggregate_summary",
     "assign_arm",
     "client_main",
+    "request_fingerprint",
 ]

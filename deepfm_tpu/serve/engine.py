@@ -57,6 +57,7 @@ import numpy as np
 from ..obs import trace as trace_lib
 from ..utils import faults as faults_lib
 from .admission import VALUE_DEFAULT, AdmissionController
+from .cache import ResultCache, request_fingerprint
 from .stats import LANE_LARGE, LANE_SMALL, ServingStats
 
 
@@ -91,8 +92,9 @@ class ServeFuture:
 
     __slots__ = ("ids", "vals", "n", "lane", "value", "t_enqueue",
                  "latency_ms", "trace_id", "model_version", "arm",
+                 "fingerprint", "cache_hit", "coalesced", "cache_bypass",
                  "_event", "_probs", "_error", "_lock", "_callbacks",
-                 "_cancelled")
+                 "_cancelled", "_followers")
 
     def __init__(self, ids: np.ndarray, vals: np.ndarray, t_enqueue: float,
                  lane: str = LANE_LARGE, trace_id: Optional[int] = None,
@@ -107,12 +109,17 @@ class ServeFuture:
         self.trace_id = trace_id            # correlation id (obs.trace)
         self.model_version: Optional[int] = None  # stamped by the flush
         self.arm: Optional[int] = None      # stamped by ExperimentRouter
+        self.fingerprint: Optional[bytes] = None  # request content hash
+        self.cache_hit = False              # resolved from the result cache
+        self.coalesced = False              # joined an in-flight leader
+        self.cache_bypass = False           # shadow lane: no cache, ever
         self._event = threading.Event()
         self._probs: Optional[np.ndarray] = None
         self._error: Optional[BaseException] = None
         self._lock = threading.Lock()
         self._callbacks: List[Callable[["ServeFuture"], None]] = []
         self._cancelled = False
+        self._followers: List["ServeFuture"] = []  # coalesced joins
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -122,11 +129,30 @@ class ServeFuture:
 
     def cancel(self) -> bool:
         """Best-effort: a cancelled future still waiting in the queue is
-        dropped at batch formation (never executed); one already in a
-        flush resolves normally and the canceller ignores the result.
-        Returns False if the future had already resolved."""
-        self._cancelled = True
-        return not self._event.is_set()
+        dropped at batch formation or flush start (never executed); one
+        already mid-predict resolves normally and the canceller ignores
+        the result. Returns False if the future had already resolved.
+
+        A coalesce LEADER with followers attached refuses cancellation
+        outright (returns False without marking): other callers' responses
+        fan out from this future's resolution, so a hedge race won
+        elsewhere must not unresolve them."""
+        with self._lock:
+            if self._followers:
+                return False
+            self._cancelled = True
+            return not self._event.is_set()
+
+    def attach_follower(self, fut: "ServeFuture") -> bool:
+        """Register ``fut`` as a coalesced follower of this in-flight
+        leader; from now on :meth:`cancel` refuses (the leader carries
+        other callers' responses). False if this future is already
+        cancelled — the caller must submit normally instead."""
+        with self._lock:
+            if self._cancelled:
+                return False
+            self._followers.append(fut)
+            return True
 
     def add_done_callback(self,
                           fn: Callable[["ServeFuture"], None]) -> None:
@@ -181,7 +207,24 @@ class ServeFuture:
 
 
 class ServingEngine:
-    """Bounded queue + pipelined batcher + bucketed jitted predict + demux."""
+    """Bounded queue + pipelined batcher + bucketed jitted predict + demux.
+
+    **Fast path** (both off by default — exact pre-existing behavior):
+    ``cache_rows`` > 0 arms a version-keyed LRU result cache
+    (:class:`~deepfm_tpu.serve.cache.ResultCache`): a submit whose
+    ``(ids, vals)`` bytes match a response already flushed under the
+    CURRENT model version resolves immediately, bit-identical to the
+    cached flush; hot swaps invalidate for free because the key carries
+    the version. ``coalesce=True`` additionally attaches concurrent
+    byte-identical requests to one in-flight leader future — one device
+    execution fans out to every joined caller (typed, first-wins, with
+    the leader refusing cancellation while it carries followers).
+    ``submit(..., bypass_cache=True)`` opts a single request out of BOTH
+    (lookup, insert, and coalescing) — the shadow lane's honesty hook.
+    """
+
+    #: ExperimentRouter probes this to route ``bypass_cache`` safely.
+    supports_cache_bypass = True
 
     def __init__(self, predict_fn: Callable[[np.ndarray, np.ndarray],
                                             np.ndarray], *,
@@ -189,6 +232,8 @@ class ServingEngine:
                  queue_rows: int = 0,
                  buckets: Optional[Sequence[int]] = None,
                  inflight: int = 2, small_rows: int = 0,
+                 cache_rows: int = 0, cache_ttl_s: float = 0.0,
+                 coalesce: bool = False,
                  stats: Optional[ServingStats] = None,
                  admission: Optional[AdmissionController] = None,
                  admission_kw: Optional[dict] = None,
@@ -223,12 +268,25 @@ class ServingEngine:
                                     | {self.max_batch}))
         if self.buckets[0] < 1:
             raise ValueError(f"buckets must be positive, got {buckets}")
+        if cache_rows < 0:
+            raise ValueError(f"cache_rows must be >= 0, got {cache_rows}")
+        if cache_ttl_s < 0:
+            raise ValueError(f"cache_ttl_s must be >= 0, got {cache_ttl_s}")
+        self.cache: Optional[ResultCache] = (
+            ResultCache(cache_rows, ttl_s=cache_ttl_s, clock=clock)
+            if cache_rows > 0 else None)
+        self.coalesce = bool(coalesce)
+        self._fp_lock = threading.Lock()
+        self._inflight_fp: dict = {}   # fingerprint -> leader ServeFuture
         self.stats = stats if stats is not None else ServingStats(clock)
         self.stats.set_policy(
             serve_queue_rows=self.queue_rows,
             serve_queue_rows_auto=(self.queue_rows_requested == 0),
             serve_inflight=self.inflight,
-            serve_small_rows=self.small_rows)
+            serve_small_rows=self.small_rows,
+            serve_cache_rows=int(cache_rows),
+            serve_cache_ttl_s=float(cache_ttl_s),
+            serve_coalesce=self.coalesce)
         self._clock = clock
         # SLO-aware admission gate (optional). ``admission_kw`` builds a
         # controller bound to THIS engine's queue/stats/clock — the form
@@ -280,6 +338,9 @@ class ServingEngine:
         kw.setdefault("queue_rows", cfg.serve_queue_rows)
         kw.setdefault("inflight", cfg.serve_inflight)
         kw.setdefault("small_rows", cfg.serve_small_rows)
+        kw.setdefault("cache_rows", cfg.serve_cache_rows)
+        kw.setdefault("cache_ttl_s", cfg.serve_cache_ttl_s)
+        kw.setdefault("coalesce", cfg.serve_coalesce)
         if cfg.serve_slo_ms > 0 or cfg.serve_shed_watermark > 0:
             kw.setdefault("admission_kw", {
                 "slo_ms": cfg.serve_slo_ms,
@@ -350,14 +411,17 @@ class ServingEngine:
     # ------------------------------------------------------------- client
     def submit(self, feat_ids: np.ndarray, feat_vals: np.ndarray,
                trace_id: Optional[int] = None,
-               value: str = VALUE_DEFAULT) -> ServeFuture:
+               value: str = VALUE_DEFAULT,
+               bypass_cache: bool = False) -> ServeFuture:
         """Enqueue one request ``(ids[n,F], vals[n,F])``; returns its
         future. Requests of at most ``small_rows`` rows enter the priority
         lane. ``trace_id`` (see ``obs.trace.new_trace_id``) rides the
         future and is stamped into the flush's trace span for
         request→model-version correlation. ``value`` is the admission
         value class (lowest shed first under pressure; ignored without an
-        admission controller). Raises
+        admission controller). ``bypass_cache`` opts this request out of
+        the result cache AND in-flight coalescing entirely (no lookup, no
+        insert, no join — the shadow lane's honesty contract). Raises
         :class:`~deepfm_tpu.serve.admission.AdmissionShed` when the gate
         refuses the class, :class:`ServerOverloaded` when the queue is
         full or the engine is shutting down, ValueError on malformed
@@ -377,6 +441,42 @@ class ServingEngine:
         fut = ServeFuture(ids, vals, self._clock(),
                           lane=LANE_SMALL if small else LANE_LARGE,
                           trace_id=trace_id, value=value)
+        fut.cache_bypass = bool(bypass_cache)
+        fast = (self.cache is not None or self.coalesce) \
+            and not fut.cache_bypass
+        if fast:
+            # Fingerprint once; rides the future to the flush demux (the
+            # cache insert point) and keys the in-flight coalesce registry.
+            fut.fingerprint = request_fingerprint(ids, vals)
+            if self.cache is not None:
+                version = self._cache_version()
+                hit = self.cache.get(version, fut.fingerprint)
+                if hit is not None:
+                    # Bit-identical to the flush that stored it; resolved
+                    # here, before admission — a hit consumes no queue
+                    # rows and no device time.
+                    fut.cache_hit = True
+                    fut.model_version = version
+                    lat = 1000.0 * (self._clock() - fut.t_enqueue)
+                    self.stats.record_cache_hit()
+                    trace_lib.instant("serve.cache", event="hit", rows=n,
+                                      trace_id=trace_id)
+                    fut.set_result(hit, latency_ms=lat)
+                    self.stats.record_request_done(lat, lane=fut.lane)
+                    return fut
+                self.stats.record_cache_miss()
+            if self.coalesce:
+                with self._fp_lock:
+                    leader = self._inflight_fp.get(fut.fingerprint)
+                if leader is not None and leader is not fut \
+                        and leader.attach_follower(fut):
+                    fut.coalesced = True
+                    self.stats.record_coalesced()
+                    trace_lib.instant("serve.cache", event="coalesce",
+                                      rows=n, trace_id=trace_id)
+                    leader.add_done_callback(
+                        lambda done, f=fut: self._fan_out(done, f))
+                    return fut
         with self._cond:
             if self._closing:
                 self.stats.record_overload()
@@ -394,7 +494,56 @@ class ServingEngine:
             (self._small if small else self._queue).append(fut)
             self._queued_rows += n
             self._cond.notify_all()
+        if fast and self.coalesce:
+            # Become the in-flight leader for this fingerprint AFTER the
+            # enqueue succeeded (a refused request must never be joined).
+            # Two racing identical submits can both enqueue — benign: the
+            # later registration wins and future joins attach to it.
+            with self._fp_lock:
+                self._inflight_fp[fut.fingerprint] = fut
+            fut.add_done_callback(self._fp_release)
         return fut
+
+    def _fan_out(self, leader: ServeFuture, follower: ServeFuture) -> None:
+        """Resolve one coalesced follower from its leader's resolution
+        (runs on the resolving thread). Copies, so followers never alias
+        the leader's arrays; errors propagate typed."""
+        now = self._clock()
+        lat = 1000.0 * (now - follower.t_enqueue)
+        follower.model_version = leader.model_version
+        if leader._error is not None:
+            self.stats.record_request_failed()
+            follower.set_error(leader._error)
+            return
+        probs = leader._probs
+        if isinstance(probs, dict):
+            probs = {k: np.array(v, copy=True) for k, v in probs.items()}
+        else:
+            probs = np.array(probs, copy=True)
+        follower.set_result(probs, latency_ms=lat)
+        self.stats.record_request_done(lat, lane=follower.lane)
+
+    def _fp_release(self, fut: ServeFuture) -> None:
+        """Leader resolved: retire its coalesce-registry entry (unless a
+        newer leader already took the fingerprint over)."""
+        with self._fp_lock:
+            if self._inflight_fp.get(fut.fingerprint) is fut:
+                self._inflight_fp.pop(fut.fingerprint, None)
+
+    def _cache_version(self):
+        """The cache key's model-version component for a request admitted
+        NOW: the installed artifact step when one is known, else the
+        watcher swap ordinal, else None (a plain static predict fn — one
+        version forever). Matches what :meth:`_flush` stamps at insert, so
+        a hot swap strands old entries unreachable (invalidated for
+        free)."""
+        step = self._model_step()
+        if step is not None:
+            return step
+        current = getattr(self._fn, "current", None)
+        if callable(current):
+            return current()[1]
+        return None
 
     def predict(self, feat_ids: np.ndarray, feat_vals: np.ndarray,
                 timeout: Optional[float] = None,
@@ -537,6 +686,19 @@ class ServingEngine:
             return None
 
     def _flush(self, batch: List[ServeFuture], rows: int) -> None:
+        # Last-chance drop BEFORE any device work: a future cancelled (or
+        # somehow resolved) after batch formation but before this flush
+        # began — the hedge-loser race window — is filtered here, so a won
+        # race never double-computes. Rows are re-counted; an emptied
+        # flush costs nothing.
+        live = [f for f in batch if not (f.cancelled() or f.done())]
+        if len(live) != len(batch):
+            trace_lib.instant("serve.flush_dropped",
+                              requests=len(batch) - len(live))
+            batch = live
+            rows = sum(f.n for f in batch)
+        if not batch:
+            return
         if len(batch) == 1:
             ids, vals = batch[0].ids, batch[0].vals
         else:
@@ -576,6 +738,7 @@ class ServingEngine:
                 return
             now = self._clock()
             off = 0
+            cache_key = step if step is not None else version
             if isinstance(out, dict):
                 # Multitask artifact: named per-task probability columns,
                 # each sliced per request — futures resolve with
@@ -587,9 +750,10 @@ class ServingEngine:
                     # loser mid-flush) keeps its first-wins stamp and this
                     # set_result is a no-op.
                     lat = 1000.0 * (now - fut.t_enqueue)
-                    fut.set_result(
-                        {k: v[off:off + fut.n] for k, v in named.items()},
-                        latency_ms=lat)
+                    sliced = {k: v[off:off + fut.n]
+                              for k, v in named.items()}
+                    self._cache_insert(fut, cache_key, sliced)
+                    fut.set_result(sliced, latency_ms=lat)
                     off += fut.n
                     self.stats.record_request_done(lat, lane=fut.lane)
             else:
@@ -597,12 +761,22 @@ class ServingEngine:
                 probs = np.asarray(out).reshape(-1)
                 for fut in batch:
                     lat = 1000.0 * (now - fut.t_enqueue)
-                    fut.set_result(probs[off:off + fut.n], latency_ms=lat)
+                    sliced = probs[off:off + fut.n]
+                    self._cache_insert(fut, cache_key, sliced)
+                    fut.set_result(sliced, latency_ms=lat)
                     off += fut.n
                     self.stats.record_request_done(lat, lane=fut.lane)
             self.stats.record_flush(rows, bucket,
                                     full=rows >= self.max_batch,
                                     version=version)
+
+    def _cache_insert(self, fut: ServeFuture, cache_key, value) -> None:
+        """Store one demuxed response under the version that EXECUTED it
+        (insert-side half of the version-keyed contract). Bypass futures
+        carry no fingerprint, so the shadow lane neither reads nor warms
+        the cache."""
+        if self.cache is not None and fut.fingerprint is not None:
+            self.cache.put(cache_key, fut.fingerprint, value, fut.n)
 
     # ---------------------------------------------------------- lifecycle
     @property

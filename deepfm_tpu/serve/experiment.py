@@ -205,13 +205,14 @@ class ExperimentRouter:
     @staticmethod
     def _submit(engine: Any, feat_ids: np.ndarray, feat_vals: np.ndarray,
                 *, trace_id: Optional[int], value: str,
-                affinity: Optional[int]) -> Any:
+                affinity: Optional[int], bypass_cache: bool = False) -> Any:
+        kw: Dict[str, Any] = {"trace_id": trace_id, "value": value}
         if affinity is not None and getattr(engine, "supports_affinity",
                                             False):
-            return engine.submit(feat_ids, feat_vals, affinity=affinity,
-                                 trace_id=trace_id, value=value)
-        return engine.submit(feat_ids, feat_vals, trace_id=trace_id,
-                             value=value)
+            kw["affinity"] = affinity
+        if bypass_cache and getattr(engine, "supports_cache_bypass", False):
+            kw["bypass_cache"] = True
+        return engine.submit(feat_ids, feat_vals, **kw)
 
     # -------------------------------------------------------- shadow lane
     def _shadow(self, feat_ids: np.ndarray, feat_vals: np.ndarray,
@@ -220,12 +221,15 @@ class ExperimentRouter:
         """Fire-and-observe duplicate to the challenger. Guarded
         wall-to-wall: ANY exception (typed refusal, validation, a dead
         engine) becomes ``shadow_submit_rejected`` — never the caller's
-        problem."""
+        problem. Shadow submits BYPASS the challenger's result cache (when
+        it advertises ``supports_cache_bypass``): the lane exists to
+        measure the challenger's real predict path, and its duplicated
+        traffic must neither read nor warm entries the live lane sees."""
         t0 = self._clock()
         try:
             sfut = self._submit(self.challenger, feat_ids, feat_vals,
                                 trace_id=trace_id, value=value,
-                                affinity=None)
+                                affinity=None, bypass_cache=True)
         except Exception:  # noqa: BLE001 — isolation IS the contract
             with self._lock:
                 self.shadow_submit_rejected += 1

@@ -94,14 +94,23 @@ class HedgedFuture:
         already over or a hedge is already attached."""
         with self._lock:
             if self._event.is_set() or self._hedge is not None:
-                pass
+                adopted = False
             else:
                 self._hedge = fut
                 self._stats.record_hedge_fired()
-                fut.add_done_callback(self._child_done)
-                return True
-        fut.cancel()
-        return False
+                adopted = True
+        if not adopted:
+            fut.cancel()
+            return False
+        # Register OUTSIDE the wrapper lock: a hedge leg can resolve the
+        # instant it is submitted (result-cache hit, coalesced join onto a
+        # finishing leader), in which case add_done_callback invokes
+        # _child_done synchronously on THIS thread — which must be able to
+        # take the wrapper lock. If the primary wins the narrow window
+        # before this line, _child_done sees the attached hedge as the
+        # loser and cancels it as usual.
+        fut.add_done_callback(self._child_done)
+        return True
 
     def _child_done(self, child: ServeFuture) -> None:
         won_by_hedge = False
@@ -130,6 +139,18 @@ class HedgedFuture:
     def done(self) -> bool:
         return self._event.is_set()
 
+    @property
+    def cache_hit(self) -> bool:
+        """True when the leg that WON the race was a cache hit."""
+        winner = self._winner
+        return (winner or self._primary).cache_hit
+
+    @property
+    def coalesced(self) -> bool:
+        """True when the winning leg joined an in-flight leader."""
+        winner = self._winner
+        return (winner or self._primary).coalesced
+
     def cancelled(self) -> bool:
         return self._primary.cancelled()
 
@@ -157,6 +178,9 @@ class ReplicatedEngine:
 
     #: The frontend checks this to pass its client id as the sticky key.
     supports_affinity = True
+    #: The experiment router checks this to bypass the result cache on the
+    #: shadow lane (every replica engine honours ``bypass_cache``).
+    supports_cache_bypass = True
 
     def __init__(self, engines: Sequence[ServingEngine], *,
                  swap_poll_secs: float = 0.0, hedge_ms: float = 0.0,
@@ -264,7 +288,8 @@ class ReplicatedEngine:
     def submit(self, feat_ids: np.ndarray, feat_vals: np.ndarray,
                affinity: Optional[int] = None,
                trace_id: Optional[int] = None,
-               value: str = VALUE_DEFAULT) -> ServeFuture:
+               value: str = VALUE_DEFAULT,
+               bypass_cache: bool = False) -> ServeFuture:
         """Route one request: sticky replica, spill on overload/shed, typed
         error only when EVERY replica refused (:class:`AdmissionShed` when
         every refusal was a shed — the fleet CHOSE to refuse this class —
@@ -286,7 +311,8 @@ class ReplicatedEngine:
             try:
                 fut = self._engines[idx].submit(feat_ids, feat_vals,
                                                 trace_id=trace_id,
-                                                value=value)
+                                                value=value,
+                                                bypass_cache=bypass_cache)
             except AdmissionShed as e:
                 last = e
                 continue
@@ -363,7 +389,8 @@ class ReplicatedEngine:
             try:
                 fut = self._engines[idx].submit(
                     hf._primary.ids, hf._primary.vals,
-                    trace_id=hf.trace_id, value=hf.value)
+                    trace_id=hf.trace_id, value=hf.value,
+                    bypass_cache=hf._primary.cache_bypass)
             except (AdmissionShed, ServerOverloaded):
                 continue    # fleet too hot to hedge; retry next pass
             if hf.attach_hedge(fut):

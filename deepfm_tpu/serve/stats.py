@@ -76,6 +76,14 @@ class ServingStats:
         self.hedges_fired = 0         # hedge submitted to another replica
         self.hedges_won = 0           # hedge resolved before the primary
         self.hedges_cancelled = 0     # losing leg cancelled after a win
+        # Fast-path accounting (serve/cache.py): a hit resolves at submit
+        # without touching the batcher; a coalesced join attaches to an
+        # in-flight leader and fans out from its flush. Both ALSO count in
+        # requests_completed (they are answered requests); these counters
+        # say how many were answered without device work of their own.
+        self.cache_hits = 0
+        self.cache_misses = 0         # cache armed, lookup missed
+        self.coalesced = 0            # joins attached to an in-flight leader
         self.degraded_by_rung: Dict[str, int] = {}
         self.degrade_transitions = 0
         self.latencies_ms: List[float] = []
@@ -142,6 +150,18 @@ class ServingStats:
     def record_hedge_cancelled(self) -> None:
         with self._lock:
             self.hedges_cancelled += 1
+
+    def record_cache_hit(self) -> None:
+        with self._lock:
+            self.cache_hits += 1
+
+    def record_cache_miss(self) -> None:
+        with self._lock:
+            self.cache_misses += 1
+
+    def record_coalesced(self) -> None:
+        with self._lock:
+            self.coalesced += 1
 
     def record_degraded(self, rung: str) -> None:
         """One request answered at a degraded cascade rung (reduced
@@ -249,6 +269,13 @@ class ServingStats:
                 "hedges_fired": self.hedges_fired,
                 "hedges_won": self.hedges_won,
                 "hedges_cancelled": self.hedges_cancelled,
+                "serving_cache_hits": self.cache_hits,
+                "serving_cache_misses": self.cache_misses,
+                "serving_cache_hit_rate": (
+                    round(self.cache_hits
+                          / (self.cache_hits + self.cache_misses), 4)
+                    if (self.cache_hits + self.cache_misses) else None),
+                "serving_coalesced": self.coalesced,
                 "serving_degraded": sum(self.degraded_by_rung.values()),
                 "serving_degraded_by_rung": dict(self.degraded_by_rung),
                 "degrade_transitions": self.degrade_transitions,
@@ -285,7 +312,9 @@ def aggregate_summary(stats: Sequence[ServingStats]) -> Dict[str, Any]:
               "serving_overloads": 0, "serving_rows": 0,
               "serving_flushes": 0, "serving_watcher_errors": 0,
               "serving_sheds": 0, "hedges_fired": 0, "hedges_won": 0,
-              "hedges_cancelled": 0, "serving_degraded": 0,
+              "hedges_cancelled": 0, "serving_cache_hits": 0,
+              "serving_cache_misses": 0, "serving_coalesced": 0,
+              "serving_degraded": 0,
               "degrade_transitions": 0, "admission_transitions": 0}
     sheds_by_class: Dict[str, int] = {}
     degraded_by_rung: Dict[str, int] = {}
@@ -309,6 +338,9 @@ def aggregate_summary(stats: Sequence[ServingStats]) -> Dict[str, Any]:
             totals["hedges_fired"] += s.hedges_fired
             totals["hedges_won"] += s.hedges_won
             totals["hedges_cancelled"] += s.hedges_cancelled
+            totals["serving_cache_hits"] += s.cache_hits
+            totals["serving_cache_misses"] += s.cache_misses
+            totals["serving_coalesced"] += s.coalesced
             totals["serving_degraded"] += sum(s.degraded_by_rung.values())
             totals["degrade_transitions"] += s.degrade_transitions
             totals["admission_transitions"] += s.admission_transitions
@@ -334,9 +366,14 @@ def aggregate_summary(stats: Sequence[ServingStats]) -> Dict[str, Any]:
     else:
         qps = 0.0 if totals["serving_requests"] == 0 else None
     known_blackouts = [b for b in blackout if b is not None]
+    looked_up = (totals["serving_cache_hits"]
+                 + totals["serving_cache_misses"])
     out = dict(totals)
     out.update({
         "replicas": len(stats),
+        "serving_cache_hit_rate": (
+            round(totals["serving_cache_hits"] / looked_up, 4)
+            if looked_up else None),
         "serving_p50_ms": _pct(lat, 50),
         "serving_p99_ms": _pct(lat, 99),
         "serving_small_requests": len(small),

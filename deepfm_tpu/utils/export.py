@@ -391,24 +391,37 @@ def load_serving(artifact_dir: str, *,
             if isinstance(out, dict):  # multitask: {task: probs}
                 return {k: np.asarray(v) for k, v in out.items()}
             return np.asarray(out)
+
+        # Traceable predict for callers that fuse the ranker into a larger
+        # jitted program (the cascade fast path): ``exported.call`` is
+        # jax-traceable, so this composes under an outer ``jax.jit``.
+        # Inputs must already be int32/float32 tracers of a bucket shape.
+        def raw_call(feat_ids, feat_vals):
+            return exported.call(params, model_state, feat_ids, feat_vals)
     else:
         # Fallback: rebuild from config (params-only artifact).
         from ..models import get_model
         model = get_model(cfg)
-        fn = jax.jit(_serving_fn(model, cfg))
+        fn_raw = _serving_fn(model, cfg)
+        fn = jax.jit(fn_raw)
 
         def serve(feat_ids: np.ndarray, feat_vals: np.ndarray) -> np.ndarray:
             out = fn(params, model_state, feat_ids, feat_vals)
             if isinstance(out, dict):
                 return {k: np.asarray(v) for k, v in out.items()}
             return np.asarray(out)
+
+        def raw_call(feat_ids, feat_vals):
+            return fn_raw(params, model_state, feat_ids, feat_vals)
     # Input width from the signature metadata: what a pre-warm caller (the
     # hot-swap watcher) needs to drive every bucket shape before the swap.
     in_cols = int(meta["signature"]["inputs"]["feat_ids"][1])
     serve.input_cols = in_cols
+    serve.raw_call = raw_call
     if buckets is not None:
         wrapped = BucketedPredict(serve, buckets)
         wrapped.input_cols = in_cols
+        wrapped.raw_call = raw_call
         return wrapped
     return serve
 

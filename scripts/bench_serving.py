@@ -2,6 +2,8 @@
 """Serving scale-out sweep: QPS/p50/p99 per replica count and in-flight
 depth -> ``SERVING_r0N.json``. With ``--flood``, the overload sweep
 instead: open-loop Zipf flood past saturation -> ``FLOOD_r0N.json``.
+With ``--fastpath``, the fast-path A/B flood (result cache + in-flight
+coalescing off vs on over identical traffic) -> ``SERVING_r0N.json``.
 
 The measurement half of ROADMAP item 1's serving receipt (the correctness
 half is ``scripts/serving_drill.py``, re-run here so the committed report
@@ -229,6 +231,71 @@ def run_flood(report_path=None, run_secs=2.5, users=1_000_000,
     return report
 
 
+def run_fastpath(report_path=None, run_secs=2.5, users=1_000_000,
+                 repeat_p=0.5, cache_rows=4096, verbose=True):
+    """Serving fast-path A/B flood -> ``SERVING_r0N.json``: the same
+    open-loop Zipf flood (0.5/1/2/4x measured saturation, per-user
+    byte-identical repeats at ``repeat_p``) served twice over ONE artifact
+    and ONE measured saturation — result cache + coalescing OFF vs ON —
+    so the p99/goodput deltas are attributable to the fast path alone.
+
+    Gates: the accounting identity (now offered == completed + coalesced +
+    sheds + overloads + timeouts + failed) closes at EVERY point of BOTH
+    arms; the ON arm sees real cache traffic (hits > 0 at every point);
+    and the headline — p99 at 2x saturation — improves by >= 25% with the
+    fast path on.
+    """
+    global say
+    if not verbose:
+        say = lambda msg: None  # noqa: E731
+    t_start = time.time()
+    say(f"fast-path A/B flood at {FLOOD_MULTS} x saturation, "
+        f"{users} Zipf users, repeat_p={repeat_p}")
+    fast = bench.serving_fastpath_series(
+        run_secs=run_secs, mults=FLOOD_MULTS, users=users,
+        repeat_p=repeat_p, cache_rows=cache_rows)
+    for c in fast["comparison"]:
+        say(f"  {c['offered_mult']}x p99 off={c['p99_ms_off']}ms "
+            f"on={c['p99_ms_on']}ms ({c['p99_improvement_pct']}%) "
+            f"hit_rate={c['cache_hit_rate_on']} "
+            f"coalesce_rate={c['coalesce_rate_on']}")
+
+    for arm in ("off", "on"):
+        for p in fast[arm]["points"]:
+            assert p["accounting_ok"], (
+                f"accounting identity broken ({arm} arm, "
+                f"{p['offered_mult']}x): {p}")
+    for p in fast["on"]["points"]:
+        assert p["cache_hits"] > 0, (
+            f"no cache hits at {p['offered_mult']}x with the fast path "
+            f"on: {p}")
+    headline = next(c for c in fast["comparison"]
+                    if c["offered_mult"] == 2.0)
+    assert headline["p99_improvement_pct"] is not None and \
+        headline["p99_improvement_pct"] >= 25.0, (
+        f"p99 at 2x saturation improved only "
+        f"{headline['p99_improvement_pct']}% with the fast path on "
+        f"(need >= 25%): {headline}")
+
+    report = {
+        "bench": "serving_fastpath",
+        "ok": True,
+        "headline": headline,
+        "fastpath": fast,
+        "offered_mults": list(FLOOD_MULTS),
+        "host_cpu_count": os.cpu_count() or 1,
+        "load_kind": fast["off"]["load_kind"],
+        "device_kind": fast["off"]["device_kind"],
+        "elapsed_s": round(time.time() - t_start, 1),
+    }
+    path = report_path or _next_report_path()
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    say(f"PASS -> {path}")
+    return report
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--report", default=None,
@@ -239,10 +306,19 @@ def main():
     ap.add_argument("--flood", action="store_true",
                     help="run the overload flood sweep -> FLOOD_r0N.json "
                          "instead of the scale-out sweep")
+    ap.add_argument("--fastpath", action="store_true",
+                    help="run the fast-path A/B flood (cache+coalescing "
+                         "off vs on) -> SERVING_r0N.json")
     ap.add_argument("--users", type=int, default=1_000_000,
-                    help="Zipf user-population size for --flood")
+                    help="Zipf user-population size for --flood/--fastpath")
+    ap.add_argument("--repeat_p", type=float, default=0.5,
+                    help="per-user byte-identical repeat probability for "
+                         "--fastpath")
     args = ap.parse_args()
-    if args.flood:
+    if args.fastpath:
+        run_fastpath(args.report, run_secs=args.run_secs, users=args.users,
+                     repeat_p=args.repeat_p)
+    elif args.flood:
         run_flood(args.report, run_secs=args.run_secs, users=args.users)
     else:
         run_sweep(args.report, run_secs=args.run_secs)

@@ -1070,6 +1070,111 @@ def run_overload_drill(workdir, *, seed=7, verbose=False,
         faults_lib.set_executor_slow(0.0, 0)
 
 
+# Cache bit-identity drill: the repeat flood is small because the claim is
+# correctness (hits occurred, responses byte-equal), not throughput.
+CACHE_DRILL = dict(duration_s=1.2, offered_qps=120.0, users=4_000,
+                   hist_len=6, retrieve_k=8, max_batch=16,
+                   queue_rows=4096, repeat_p=0.5, cache_rows=2048,
+                   user_cache_rows=512, k=5, timeout_s=30.0)
+
+
+def run_cache_drill(workdir, *, seed=7, verbose=False, publish_dir=None,
+                    params=None):
+    """Serving fast-path bit-identity drill: serve ONE repeat-heavy flood
+    plan through the cascade twice over the same artifact — result cache +
+    coalescing OFF, then ON — and assert (1) the ON arm actually took the
+    fast path (engine cache hits > 0 on a plan with repeats > 0) and
+    (2) the audit fingerprint over every request's full recommendation
+    (ids AND probability bytes) is IDENTICAL across arms: a cached answer
+    is byte-equal to the computed one, end to end through the cascade.
+
+    Requests are served sequentially in plan order (correctness drill, not
+    a load drill), so both arms see identical request streams and the
+    fingerprints are deterministic."""
+    say = _say_factory(verbose)
+    P = dict(CACHE_DRILL)
+    P.update(params or {})
+    from deepfm_tpu.loop.traffic import FloodTrafficPlan, ZipfUserPopulation
+    from deepfm_tpu.rec.cascade import CascadeEngine
+
+    t_start = time.time()
+    os.environ["DEEPFM_TPU_SKIP_TF_EXPORT"] = "1"
+    try:
+        if publish_dir is None:
+            publish_dir = build_cascade_artifact(
+                os.path.join(workdir, "cache_publish"), say=say)
+
+        def serve_arm(cache_on):
+            # Fresh same-seed population per arm: identical plans, so the
+            # fingerprint delta (none) is attributable to the cache alone.
+            population = ZipfUserPopulation(
+                seed, users=P["users"], hist_len=P["hist_len"])
+            plan = FloodTrafficPlan(
+                seed + 1, offered_qps=P["offered_qps"],
+                duration_s=P["duration_s"], population=population,
+                field_size=FIELD_SIZE, feature_size=FEATURE_SIZE,
+                repeat_p=P["repeat_p"])
+            kw = {}
+            if cache_on:
+                kw = dict(cache_rows=P["cache_rows"], coalesce=True,
+                          user_cache_rows=P["user_cache_rows"])
+            eng = CascadeEngine(
+                publish_dir, retrieve_k=P["retrieve_k"],
+                max_batch=P["max_batch"], max_delay_ms=0.5,
+                queue_rows=P["queue_rows"],
+                watcher_kw={"poll_secs": 3600}, **kw)
+            h = hashlib.sha256()
+            try:
+                for r in plan.requests:
+                    ids_k, probs_k = eng.recommend(
+                        r.hist_ids, r.hist_mask, r.ids[0], r.vals[0],
+                        k=P["k"], timeout=P["timeout_s"], value=r.value)
+                    h.update(np.asarray(ids_k, np.int64).tobytes())
+                    h.update(np.asarray(probs_k, np.float32).tobytes())
+                summary = eng.stats.summary()
+            finally:
+                eng.close()
+            return {
+                "requests": len(plan.requests),
+                "repeat_requests": plan.repeat_requests,
+                "fingerprint": h.hexdigest()[:16],
+                "cache_hits": summary["serving_cache_hits"],
+                "cache_misses": summary["serving_cache_misses"],
+                "coalesced": summary["serving_coalesced"],
+                "user_cache_hits": eng.user_cache_hits,
+            }
+
+        say("cache drill: serving the repeat flood with the fast path OFF")
+        off = serve_arm(False)
+        say("cache drill: same plan with the fast path ON")
+        on = serve_arm(True)
+        assert on["repeat_requests"] == off["repeat_requests"] > 0, (
+            off, on)
+        assert on["cache_hits"] > 0, (
+            f"fast path ON served {on['requests']} requests "
+            f"({on['repeat_requests']} repeats) with zero cache hits: {on}")
+        assert off["cache_hits"] == 0, off
+        bit_identical = on["fingerprint"] == off["fingerprint"]
+        assert bit_identical, (
+            f"cache-on responses diverged from cache-off: "
+            f"{off['fingerprint']} vs {on['fingerprint']}")
+        say(f"bit-identical arms ({on['fingerprint']}); "
+            f"hits={on['cache_hits']} coalesced={on['coalesced']} "
+            f"user_hits={on['user_cache_hits']}")
+        return {
+            "drill": "cache",
+            "seed": seed,
+            "params": {k: P[k] for k in sorted(P)},
+            "off": off,
+            "on": on,
+            "bit_identical": bit_identical,
+            "audit_fingerprint": on["fingerprint"],
+            "elapsed_s": round(time.time() - t_start, 1),
+        }
+    finally:
+        os.environ.pop("DEEPFM_TPU_SKIP_TF_EXPORT", None)
+
+
 def _experiment_batches(plan, batch_size, count):
     """Deterministic training batches built by cycling the traffic plan's
     rows — candidates train on the same distribution they are judged on,

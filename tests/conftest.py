@@ -99,6 +99,11 @@ def pytest_configure(config):
         "canary routing, shadow-lane isolation, promotion controller, "
         "pointer-history audit sidecar, experimentation drill); the "
         "full-parameter drill is also slow")
+    config.addinivalue_line(
+        "markers",
+        "cache: serving fast-path tests (version-keyed result cache, "
+        "in-flight coalescing, fused cascade program, repeat-flood "
+        "smoke)")
 
 
 # ---------------------------------------------------------------------------

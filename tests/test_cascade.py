@@ -434,7 +434,190 @@ class TestPublisherIntegration:
             eng.close()
 
 
+# ---------------------------------------------------------------------------
+# Fused device-side cascade program (serving fast path)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def brute_publish(tmp_path_factory, cascade_publish, item_matrix):
+    """A second publish dir whose version 1 carries a BRUTE index — the
+    fusable kind — over the same trained ranker and towers."""
+    pub = cascade_publish
+    index = CandidateIndex(item_matrix, kind="brute")
+    publish_dir = str(tmp_path_factory.mktemp("cascade_pub_brute"))
+    orig = export_lib._export_tf_savedmodel
+    export_lib._export_tf_savedmodel = lambda *a, **k: None
+    try:
+        export_cascade(
+            pub["trainer"].model, pub["state"], pub["cfg"],
+            os.path.join(publish_dir, "1"),
+            tower_params=pub["tower_params"], index=index)
+        export_lib.write_latest(publish_dir, "1")
+    finally:
+        export_lib._export_tf_savedmodel = orig
+    return publish_dir
+
+
+class TestFusedCascade:
+    def _request(self, seed=0, hist_rows=4):
+        rng = np.random.default_rng(seed)
+        hist_ids = rng.integers(
+            1, FEATURE_SIZE, (HIST_LEN,)).astype(np.int32)
+        hist_mask = np.zeros((HIST_LEN,), np.float32)
+        hist_mask[:hist_rows] = 1.0
+        feat_ids = rng.integers(
+            0, FEATURE_SIZE, (FIELD_SIZE,)).astype(np.int32)
+        feat_vals = rng.normal(size=(FIELD_SIZE,)).astype(np.float32)
+        return hist_ids, hist_mask, feat_ids, feat_vals
+
+    @pytest.fixture()
+    def engines(self, brute_publish):
+        staged = CascadeEngine(
+            brute_publish, retrieve_k=16, max_batch=BATCH,
+            max_delay_ms=1.0, watcher_kw={"poll_secs": 3600, "start": False})
+        fused = CascadeEngine(
+            brute_publish, retrieve_k=16, max_batch=BATCH,
+            max_delay_ms=1.0, fused=True,
+            watcher_kw={"poll_secs": 3600, "start": False})
+        try:
+            yield staged, fused
+        finally:
+            staged.close()
+            fused.close()
+
+    def test_artifact_exposes_traceable_ranker(self, engines):
+        staged, fused = engines
+        model = fused.current()
+        assert getattr(model.rank_fn, "raw_call", None) is not None
+        assert model.supports_fused
+
+    def test_fused_matches_staged_bit_identical(self, engines):
+        """The acceptance pin: the fused single-program path returns the
+        SAME items with BIT-IDENTICAL ranker probabilities as the staged
+        user_embed -> search -> substitute -> rank -> argsort path."""
+        staged, fused = engines
+        for seed in (1, 2, 3):
+            req = self._request(seed=seed)
+            s_items, s_probs = staged.recommend(*req, k=8)
+            f_items, f_probs = fused.recommend(*req, k=8)
+            np.testing.assert_array_equal(f_items, s_items)
+            np.testing.assert_array_equal(f_probs, s_probs)
+        assert fused.fused_calls >= 3
+        assert staged.fused_calls == 0
+
+    def test_fused_empty_history_finite(self, engines):
+        _, fused = engines
+        _, _, feat_ids, feat_vals = self._request(seed=7)
+        items, probs = fused.recommend(
+            np.zeros((HIST_LEN,), np.int32),
+            np.zeros((HIST_LEN,), np.float32), feat_ids, feat_vals, k=5)
+        assert np.all(np.isfinite(probs))
+        assert np.all((probs >= 0) & (probs <= 1))
+
+    def test_recommend_batch_matches_per_row(self, engines):
+        staged, fused = engines
+        reqs = [self._request(seed=s) for s in (11, 12, 13)]
+        h_ids = np.stack([r[0] for r in reqs])
+        h_mask = np.stack([r[1] for r in reqs])
+        f_ids = np.stack([r[2] for r in reqs])
+        f_vals = np.stack([r[3] for r in reqs])
+        b_items, b_probs = fused.recommend_batch(
+            h_ids, h_mask, f_ids, f_vals, k=6)
+        assert b_items.shape == (3, 6) and b_probs.shape == (3, 6)
+        for i, req in enumerate(reqs):
+            items, probs = staged.recommend(*req, k=6)
+            np.testing.assert_array_equal(b_items[i], items)
+            # Batched dispatch changes XLA's row vectorization — float-ULP
+            # agreement, not bit (the B=1 fused path IS bit-equal, pinned
+            # above).
+            np.testing.assert_allclose(b_probs[i], probs, rtol=1e-5)
+
+    def test_fused_compile_cache_is_bucketed(self, engines):
+        """pow2 compile discipline: batches 1 and 3 share no key with each
+        other (bucket 1 vs 4) but batch 3 and 4 share one program."""
+        _, fused = engines
+        model = fused.current()
+        before = len(model._fused_cache)
+        reqs = [self._request(seed=s) for s in (21, 22, 23, 24)]
+        h_ids = np.stack([r[0] for r in reqs])
+        h_mask = np.stack([r[1] for r in reqs])
+        f_ids = np.stack([r[2] for r in reqs])
+        f_vals = np.stack([r[3] for r in reqs])
+        fused.recommend_batch(h_ids[:3], h_mask[:3], f_ids[:3], f_vals[:3],
+                              k=4)
+        n_after_3 = len(model._fused_cache)
+        fused.recommend_batch(h_ids, h_mask, f_ids, f_vals, k=4)
+        assert len(model._fused_cache) == n_after_3  # 3 and 4 share bucket 4
+        assert n_after_3 <= before + 1
+
+    def test_ann_index_gates_to_staged(self, cascade_publish):
+        """fused=True over an ANN artifact serves via the staged path (the
+        host-side partition scan cannot be traced) — no error, no fused
+        dispatch."""
+        eng = CascadeEngine(
+            cascade_publish["dir"], retrieve_k=8, max_batch=BATCH,
+            fused=True, watcher_kw={"poll_secs": 3600, "start": False})
+        try:
+            assert not eng.current().supports_fused
+            req = self._request(seed=31)
+            items, probs = eng.recommend(*req, k=4)
+            assert np.all(np.isfinite(probs))
+            assert eng.fused_calls == 0
+        finally:
+            eng.close()
+
+
+class TestNoHistoryCascade:
+    def test_history_free_artifact_serves_end_to_end(
+            self, tmp_path_factory, towers, item_matrix):
+        """Satellite pin: a ranker exported WITHOUT history columns
+        (hist_len == 0) serves the full cascade — no history fitting, no
+        zero-length scratch concat, finite output on both the staged and
+        fused paths."""
+        from deepfm_tpu.train import Trainer
+        cfg = _cfg(model="deepfm", history_max_len=0)
+        trainer = Trainer(cfg)
+        state = trainer.init_state()
+        _, tower_params, _ = towers
+        index = CandidateIndex(item_matrix, kind="brute")
+        publish_dir = str(tmp_path_factory.mktemp("cascade_pub_nohist"))
+        orig = export_lib._export_tf_savedmodel
+        export_lib._export_tf_savedmodel = lambda *a, **k: None
+        try:
+            export_cascade(
+                trainer.model, state, cfg,
+                os.path.join(publish_dir, "1"),
+                tower_params=tower_params, index=index)
+            export_lib.write_latest(publish_dir, "1")
+        finally:
+            export_lib._export_tf_savedmodel = orig
+        rng = np.random.default_rng(5)
+        hist_ids = rng.integers(1, FEATURE_SIZE, (HIST_LEN,)).astype(np.int32)
+        hist_mask = np.ones((HIST_LEN,), np.float32)
+        feat_ids = rng.integers(0, FEATURE_SIZE,
+                                (FIELD_SIZE,)).astype(np.int32)
+        feat_vals = rng.normal(size=(FIELD_SIZE,)).astype(np.float32)
+        for fused in (False, True):
+            eng = CascadeEngine(
+                publish_dir, retrieve_k=8, max_batch=BATCH, fused=fused,
+                watcher_kw={"poll_secs": 3600, "start": False})
+            try:
+                assert eng.current().hist_len == 0
+                items, probs = eng.recommend(
+                    hist_ids, hist_mask, feat_ids, feat_vals, k=4)
+                assert items.shape == (4,) and probs.shape == (4,)
+                assert np.all(np.isfinite(probs))
+            finally:
+                eng.close()
+
+
 class TestFitHistory:
+    def test_zero_hist_len_short_circuits(self):
+        ids, mask = _fit_history(np.array([3, 4], np.int32),
+                                 np.array([1, 1], np.float32), 0)
+        assert ids.shape == (0,) and mask.shape == (0,)
+        assert ids.dtype == np.int32 and mask.dtype == np.float32
+
     def test_pad_short_history(self):
         ids, mask = _fit_history(np.array([3, 4], np.int32),
                                  np.array([1, 1], np.float32), 5)

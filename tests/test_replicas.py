@@ -377,6 +377,55 @@ class TestHedging:
                 e.start()
             fleet.close(timeout=30)
 
+    def test_cancelled_hedge_loser_never_reaches_the_device(self):
+        """Regression: a hedge loser cancelled while its flush sits in the
+        batcher->executor handoff must be dropped by the flush prologue,
+        not computed and discarded. The primary replica is plugged by an
+        in-flight batch (inflight=1 holds the loser's formed flush), the
+        hedge wins on the healthy replica, and only then does the plug
+        release — if the loser still reached the device, its feature id
+        would show up in the plugged replica's seen-set."""
+        seen = []
+        entered = threading.Event()
+        gate = threading.Event()
+
+        def plugged_predict(feat_ids, feat_vals):
+            seen.extend(np.asarray(feat_ids)[:, 0].tolist())
+            if int(feat_ids[0, 0]) == 999:
+                entered.set()
+                assert gate.wait(timeout=30)
+            return base_predict(feat_ids, feat_vals)
+
+        eng0 = ServingEngine(plugged_predict, max_batch=8, max_delay_ms=1,
+                             inflight=1)
+        eng1 = ServingEngine(base_predict, max_batch=8, max_delay_ms=1)
+        fleet = ReplicatedEngine([eng0, eng1], hedge_ms=5.0, start=False)
+        try:
+            plug = eng0.submit(*_rows(1, base=999))
+            assert entered.wait(timeout=10)
+            hf = fleet.submit(*_rows(1, base=777), affinity=0)
+            # Wait for the batcher to form the loser's flush (it parks in
+            # the handoff behind the plugged inflight slot).
+            deadline = time.monotonic() + 10
+            while eng0.pending_rows and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert eng0.pending_rows == 0
+            assert fleet.hedge_pass(now=hf.t_enqueue + 10.0) == 1
+            np.testing.assert_array_equal(
+                hf.result(timeout=10), np.full(1, 777.5, np.float32))
+            assert hf._primary.cancelled()
+            gate.set()
+            np.testing.assert_array_equal(
+                plug.result(timeout=10), np.full(1, 999.5, np.float32))
+            fleet.close(timeout=30)
+            assert 777 not in seen
+            s = fleet.summary()
+            assert s["hedges_won"] == 1
+            assert s["hedges_cancelled"] == 1
+        finally:
+            gate.set()
+            fleet.close(timeout=30)
+
     def test_hedge_delay_tracks_fleet_p99_above_floor(self):
         fleet = self._hedged_fleet(hedge_ms=5.0)
         try:
